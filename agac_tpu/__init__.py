@@ -1,0 +1,24 @@
+"""agac_tpu — a from-scratch framework with the capabilities of
+omi-lab/aws-global-accelerator-controller.
+
+The reference (mounted read-only at /root/reference) is a ~8k-LoC Go
+Kubernetes controller; this package re-implements its full capability
+surface as an idiomatic Python framework (see SURVEY.md for the layer
+map and component inventory the design follows):
+
+- a generic level-triggered reconcile kernel (``agac_tpu.reconcile``)
+  with rate-limited workqueues,
+- a cluster I/O layer (``agac_tpu.cluster``) with typed objects,
+  shared informers, listers, an event recorder, and both a fake
+  in-memory apiserver and a real-apiserver REST client,
+- a cloud-provider layer (``agac_tpu.cloudprovider``) with the AWS
+  Global Accelerator / ELBv2 / Route53 drivers behind injectable
+  interfaces plus an in-memory fake AWS backend,
+- three controllers (``agac_tpu.controllers``): globalaccelerator,
+  route53, endpointgroupbinding,
+- a validating admission webhook (``agac_tpu.webhook``),
+- leader election, signals, a controller manager, a CLI, and manifest
+  generation.
+"""
+
+VERSION = "0.1.0"

@@ -1,0 +1,26 @@
+"""The generic reconcile kernel: rate-limited workqueues plus the
+level-triggered process-next-work-item loop.
+
+Capability parity with the reference's ``pkg/reconcile/reconcile.go``
+and the client-go ``util/workqueue`` machinery it builds on.
+"""
+
+from .result import Result
+from .workqueue import (
+    BucketRateLimiter,
+    ItemExponentialFailureRateLimiter,
+    MaxOfRateLimiter,
+    RateLimitingQueue,
+    default_controller_rate_limiter,
+)
+from .reconcile import process_next_work_item
+
+__all__ = [
+    "Result",
+    "RateLimitingQueue",
+    "ItemExponentialFailureRateLimiter",
+    "BucketRateLimiter",
+    "MaxOfRateLimiter",
+    "default_controller_rate_limiter",
+    "process_next_work_item",
+]

@@ -1,0 +1,124 @@
+"""The level-triggered reconcile loop.
+
+Capability parity with the reference's ``pkg/reconcile/reconcile.go``:
+``process_next_work_item`` pops one key from a rate-limited workqueue,
+resolves it to an object through ``key_to_obj`` (a lister/cache read),
+dispatches to the delete path when the object is gone
+(``reconcile.go:62-63``) or to the create-or-update path with a deep
+copy of the cached object (``reconcile.go:67``), then applies the retry
+policy (``reconcile.go:70-89``):
+
+- processing raised → rate-limited requeue, unless the exception chain
+  contains a ``NoRetryError`` (``pkg/errors/errors.go:33-39``);
+- ``Result.requeue_after > 0`` → forget (reset backoff) then re-add
+  after the fixed delay;
+- ``Result.requeue`` → rate-limited requeue;
+- success → forget.
+
+Instead of Go's ``(Result, error)`` pairs, process functions here
+return a ``Result`` and signal errors by raising; ``NotFoundError``
+from ``key_to_obj`` selects the delete path, mirroring apimachinery's
+``IsNotFound`` dispatch.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Callable
+
+from .. import klog
+from ..errors import NoRetryError, NotFoundError, is_no_retry
+from .result import Result
+from .workqueue import RateLimitingQueue
+
+KeyToObjFunc = Callable[[str], Any]
+ProcessDeleteFunc = Callable[[str], Result]
+ProcessCreateOrUpdateFunc = Callable[[Any], Result]
+
+
+def process_next_work_item(
+    queue: RateLimitingQueue,
+    key_to_obj: KeyToObjFunc,
+    process_delete: ProcessDeleteFunc,
+    process_create_or_update: ProcessCreateOrUpdateFunc,
+) -> bool:
+    """Process one queue item; False only when the queue shut down.
+
+    The analog of ``ProcessNextWorkItem`` (reference
+    ``pkg/reconcile/reconcile.go:26-42``): errors from the handler are
+    logged and swallowed so the worker loop keeps running (crash
+    containment, the analog of ``utilruntime.HandleError``).
+    """
+    item, shutdown = queue.get()
+    if shutdown:
+        return False
+    try:
+        _reconcile_handler(item, queue, key_to_obj, process_delete, process_create_or_update)
+    except Exception as err:  # containment: a bad item must not kill the worker
+        klog.errorf("unhandled error reconciling %r: %s", item, err)
+    finally:
+        queue.done(item)
+    return True
+
+
+def _reconcile_handler(
+    key: Any,
+    queue: RateLimitingQueue,
+    key_to_obj: KeyToObjFunc,
+    process_delete: ProcessDeleteFunc,
+    process_create_or_update: ProcessCreateOrUpdateFunc,
+) -> None:
+    if not isinstance(key, str):
+        queue.forget(key)
+        klog.errorf("expected string in workqueue but got %r", key)
+        return
+    start = time.monotonic()
+    try:
+        res, err = _dispatch(key, key_to_obj, process_delete, process_create_or_update)
+    finally:
+        klog.v(4).infof("Finished syncing %r (%.3fs)", key, time.monotonic() - start)
+
+    if err is not None:
+        if is_no_retry(err):
+            klog.errorf("error syncing %r: %s", key, err)
+        else:
+            queue.add_rate_limited(key)
+            klog.errorf("error syncing %r, and requeued: %s", key, err)
+    elif res.requeue_after > 0:
+        queue.forget(key)
+        queue.add_after(key, res.requeue_after)
+        klog.infof("Successfully synced %r, but requeued after %.1fs", key, res.requeue_after)
+    elif res.requeue:
+        queue.add_rate_limited(key)
+        klog.infof("Successfully synced %r, but requeued", key)
+    else:
+        queue.forget(key)
+        klog.infof("Successfully synced %r", key)
+
+
+def _dispatch(
+    key: str,
+    key_to_obj: KeyToObjFunc,
+    process_delete: ProcessDeleteFunc,
+    process_create_or_update: ProcessCreateOrUpdateFunc,
+) -> tuple[Result, Exception | None]:
+    try:
+        obj = key_to_obj(key)
+    except NotFoundError:
+        try:
+            return process_delete(key), None
+        except Exception as err:
+            return Result(), err
+    except Exception as err:
+        # A store read failing for any reason other than NotFound is
+        # logged without a requeue in the reference
+        # (``reconcile.go:64-65`` returns before the retry policy);
+        # NoRetryError reproduces exactly that.
+        return Result(), NoRetryError(f"Unable to retrieve {key!r} from store: {err}")
+    try:
+        # DeepCopy before mutation: the cache/lister owns ``obj``
+        # (reference ``pkg/reconcile/reconcile.go:67``).
+        return process_create_or_update(copy.deepcopy(obj)), None
+    except Exception as err:
+        return Result(), err

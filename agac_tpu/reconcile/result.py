@@ -1,0 +1,16 @@
+"""Reconcile result contract.
+
+Mirrors the reference's ``pkg/reconcile/reconcile.go:17-20``: a process
+function reports whether the item should be requeued (rate-limited) or
+re-scheduled after a fixed delay.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0  # seconds; > 0 wins over ``requeue``

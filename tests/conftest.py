@@ -1,0 +1,13 @@
+"""Test harness configuration.
+
+Multi-chip sharding anywhere in the test suite runs on a virtual
+8-device CPU mesh, per the driver contract; the core controller
+framework itself has no JAX dependency (the reference is a Go
+Kubernetes controller with no tensor workload — SURVEY.md preamble).
+These env vars must be set before jax is first imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")

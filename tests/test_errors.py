@@ -1,0 +1,58 @@
+"""Error-taxonomy tests, mirroring the reference's
+``pkg/errors/errors_test.go`` (direct and wrapped NoRetry detection)."""
+
+from agac_tpu.errors import (
+    NoRetryError,
+    NotFoundError,
+    is_no_retry,
+    is_not_found,
+    no_retry_errorf,
+)
+
+
+def test_plain_error_is_not_no_retry():
+    assert not is_no_retry(RuntimeError("boom"))
+
+
+def test_no_retry_error_detected():
+    assert is_no_retry(NoRetryError("nope"))
+
+
+def test_no_retry_errorf_formats():
+    err = no_retry_errorf("invalid resource key: %s", "a/b/c")
+    assert isinstance(err, NoRetryError)
+    assert str(err) == "invalid resource key: a/b/c"
+
+
+def test_wrapped_no_retry_detected_via_cause():
+    # The analog of errors.As unwrapping (reference errors.go:33-39).
+    try:
+        try:
+            raise NoRetryError("inner")
+        except NoRetryError as inner:
+            raise RuntimeError("outer") from inner
+    except RuntimeError as outer:
+        assert is_no_retry(outer)
+
+
+def test_implicit_context_is_not_no_retry():
+    # An error that merely occurred inside an ``except NoRetryError``
+    # block was not wrapped by the raiser — it keeps its own retry
+    # semantics (only explicit ``raise ... from`` chains count, the
+    # analog of Go's errors.As over Unwrap).
+    try:
+        try:
+            raise NoRetryError("inner")
+        except NoRetryError:
+            raise RuntimeError("transient, refetch")  # implicit __context__
+    except RuntimeError as outer:
+        assert not is_no_retry(outer)
+
+
+def test_none_is_not_no_retry():
+    assert not is_no_retry(None)
+
+
+def test_not_found():
+    assert is_not_found(NotFoundError("Service", "default/foo"))
+    assert not is_not_found(RuntimeError())

@@ -1,0 +1,189 @@
+"""Reconcile-kernel retry-policy matrix, the spec encoded by the
+reference's ``pkg/reconcile/reconcile.go:59-90`` (SURVEY.md §7 stage 1):
+
+| outcome of processing           | queue effect                       |
+|---------------------------------|------------------------------------|
+| lookup NotFound                 | delete path runs                   |
+| lookup other error              | logged, NO requeue                 |
+| process raises                  | rate-limited requeue               |
+| process raises NoRetryError     | logged, NO requeue                 |
+| Result(requeue_after=d)         | forget + add_after(d)              |
+| Result(requeue=True)            | rate-limited requeue               |
+| Result()                        | forget                             |
+"""
+
+import copy
+import dataclasses
+
+import pytest
+
+from agac_tpu.errors import NoRetryError, NotFoundError
+from agac_tpu.reconcile import Result, process_next_work_item
+from agac_tpu.reconcile.workqueue import RateLimitingQueue
+
+
+class RecordingQueue(RateLimitingQueue):
+    """A real queue that also records the kernel's policy calls."""
+
+    def __init__(self):
+        super().__init__(name="recording")
+        self.calls = []
+
+    def add_rate_limited(self, item):
+        self.calls.append(("add_rate_limited", item))
+        super().add_rate_limited(item)
+
+    def add_after(self, item, delay):
+        self.calls.append(("add_after", item, delay))
+        super().add_after(item, delay)
+
+    def forget(self, item):
+        self.calls.append(("forget", item))
+        super().forget(item)
+
+
+@dataclasses.dataclass
+class Obj:
+    name: str
+    labels: dict
+
+
+@pytest.fixture
+def queue():
+    q = RecordingQueue()
+    yield q
+    q.shutdown()
+
+
+def run_one(queue, key_to_obj, process_delete, process_create_or_update):
+    assert process_next_work_item(queue, key_to_obj, process_delete, process_create_or_update)
+
+
+def test_not_found_dispatches_delete(queue):
+    deleted = []
+    queue.add("ns/gone")
+
+    def key_to_obj(key):
+        raise NotFoundError("Service", key)
+
+    def process_delete(key):
+        deleted.append(key)
+        return Result()
+
+    run_one(queue, key_to_obj, process_delete, lambda obj: pytest.fail("wrong path"))
+    assert deleted == ["ns/gone"]
+    assert ("forget", "ns/gone") in queue.calls
+
+
+def test_lookup_error_is_not_requeued(queue):
+    queue.add("ns/broken")
+
+    def key_to_obj(key):
+        raise RuntimeError("store exploded")
+
+    run_one(queue, key_to_obj, lambda k: pytest.fail(), lambda o: pytest.fail())
+    assert not any(c[0] == "add_rate_limited" for c in queue.calls)
+
+
+def test_success_forgets(queue):
+    queue.add("ns/ok")
+    run_one(queue, lambda k: Obj(k, {}), lambda k: pytest.fail(), lambda obj: Result())
+    assert queue.calls == [("forget", "ns/ok")]
+    assert len(queue) == 0
+
+
+def test_error_requeues_rate_limited(queue):
+    queue.add("ns/fail")
+
+    def process(obj):
+        raise RuntimeError("aws is down")
+
+    run_one(queue, lambda k: Obj(k, {}), lambda k: pytest.fail(), process)
+    assert any(c[0] == "add_rate_limited" for c in queue.calls)
+    assert not any(c[0] == "forget" for c in queue.calls)
+    # and the item really comes back
+    item, shutdown = queue.get(timeout=2)
+    assert (item, shutdown) == ("ns/fail", False)
+
+
+def test_no_retry_error_not_requeued(queue):
+    queue.add("ns/bad")
+
+    def process(obj):
+        raise NoRetryError("object is not Service")
+
+    run_one(queue, lambda k: Obj(k, {}), lambda k: pytest.fail(), process)
+    assert not any(c[0] == "add_rate_limited" for c in queue.calls)
+
+
+def test_wrapped_no_retry_error_not_requeued(queue):
+    queue.add("ns/bad")
+
+    def process(obj):
+        try:
+            raise NoRetryError("inner")
+        except NoRetryError as inner:
+            raise RuntimeError("outer") from inner
+
+    run_one(queue, lambda k: Obj(k, {}), lambda k: pytest.fail(), process)
+    assert not any(c[0] == "add_rate_limited" for c in queue.calls)
+
+
+def test_requeue_after_forgets_then_delays(queue):
+    queue.add("ns/wait")
+    run_one(
+        queue,
+        lambda k: Obj(k, {}),
+        lambda k: pytest.fail(),
+        lambda obj: Result(requeue=True, requeue_after=0.05),
+    )
+    assert ("forget", "ns/wait") in queue.calls
+    assert any(c[0] == "add_after" and c[2] == 0.05 for c in queue.calls)
+    item, shutdown = queue.get(timeout=2)
+    assert (item, shutdown) == ("ns/wait", False)
+
+
+def test_requeue_true_rate_limits(queue):
+    queue.add("ns/again")
+    run_one(queue, lambda k: Obj(k, {}), lambda k: pytest.fail(), lambda obj: Result(requeue=True))
+    assert any(c[0] == "add_rate_limited" for c in queue.calls)
+
+
+def test_process_receives_deep_copy(queue):
+    original = Obj("ns/x", {"k": "v"})
+    queue.add("ns/x")
+
+    def process(obj):
+        assert obj == original
+        assert obj is not original
+        obj.labels["k"] = "mutated"  # must not leak into the store
+        return Result()
+
+    run_one(queue, lambda k: original, lambda k: pytest.fail(), process)
+    assert original.labels == {"k": "v"}
+
+
+def test_non_string_key_forgotten(queue):
+    queue.add(42)
+    run_one(queue, lambda k: pytest.fail(), lambda k: pytest.fail(), lambda o: pytest.fail())
+    assert ("forget", 42) in queue.calls
+
+
+def test_shutdown_returns_false(queue):
+    queue.shutdown()
+    assert not process_next_work_item(
+        queue, lambda k: None, lambda k: Result(), lambda o: Result()
+    )
+
+
+def test_delete_path_error_requeues(queue):
+    queue.add("ns/gone")
+
+    def key_to_obj(key):
+        raise NotFoundError("Service", key)
+
+    def process_delete(key):
+        raise RuntimeError("cloud cleanup failed")
+
+    run_one(queue, key_to_obj, process_delete, lambda o: pytest.fail())
+    assert any(c[0] == "add_rate_limited" for c in queue.calls)

@@ -1,0 +1,150 @@
+"""Workqueue semantics tests: dedup, in-flight coalescing, delayed and
+rate-limited adds, shutdown — the client-go contract the reference's
+controllers rely on (SURVEY.md §2 row 5)."""
+
+import threading
+import time
+
+import pytest
+
+from agac_tpu.reconcile.workqueue import (
+    BucketRateLimiter,
+    ItemExponentialFailureRateLimiter,
+    MaxOfRateLimiter,
+    RateLimitingQueue,
+)
+
+
+@pytest.fixture
+def queue():
+    q = RateLimitingQueue(name="test")
+    yield q
+    q.shutdown()
+
+
+def test_fifo_order(queue):
+    queue.add("a")
+    queue.add("b")
+    assert queue.get() == ("a", False)
+    assert queue.get() == ("b", False)
+
+
+def test_duplicate_adds_coalesce(queue):
+    queue.add("a")
+    queue.add("a")
+    assert len(queue) == 1
+    item, _ = queue.get()
+    queue.done(item)
+    assert len(queue) == 0
+
+
+def test_add_while_processing_requeues_on_done(queue):
+    queue.add("a")
+    item, _ = queue.get()
+    queue.add("a")  # arrives while "a" is being processed
+    assert len(queue) == 0  # not handed out concurrently
+    queue.done(item)
+    assert len(queue) == 1  # re-queued after done
+    assert queue.get() == ("a", False)
+
+
+def test_get_blocks_until_add(queue):
+    results = []
+
+    def worker():
+        results.append(queue.get())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    queue.add("x")
+    t.join(timeout=2)
+    assert results == [("x", False)]
+
+
+def test_get_timeout_returns_none_not_shutdown(queue):
+    assert queue.get(timeout=0.01) == (None, False)
+
+
+def test_add_after_delivers_later(queue):
+    start = time.monotonic()
+    queue.add_after("later", 0.1)
+    assert queue.get(timeout=0.02) == (None, False)
+    item, shutdown = queue.get(timeout=2)
+    assert (item, shutdown) == ("later", False)
+    assert time.monotonic() - start >= 0.09
+
+
+def test_add_after_zero_is_immediate(queue):
+    queue.add_after("now", 0)
+    assert queue.get(timeout=1) == ("now", False)
+
+
+def test_add_after_ordering(queue):
+    queue.add_after("slow", 0.15)
+    queue.add_after("fast", 0.02)
+    assert queue.get(timeout=2)[0] == "fast"
+    assert queue.get(timeout=2)[0] == "slow"
+
+
+def test_shutdown_unblocks_get(queue):
+    results = []
+
+    def worker():
+        results.append(queue.get())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    queue.shutdown()
+    t.join(timeout=2)
+    assert results == [(None, True)]
+    assert queue.shutting_down()
+
+
+def test_add_after_shutdown_is_noop(queue):
+    queue.shutdown()
+    queue.add("x")
+    assert len(queue) == 0
+
+
+def test_rate_limited_backoff_grows_and_forget_resets():
+    limiter = ItemExponentialFailureRateLimiter(base_delay=0.005, max_delay=1000.0)
+    assert limiter.when("a") == pytest.approx(0.005)
+    assert limiter.when("a") == pytest.approx(0.01)
+    assert limiter.when("a") == pytest.approx(0.02)
+    assert limiter.num_requeues("a") == 3
+    # independent per item
+    assert limiter.when("b") == pytest.approx(0.005)
+    limiter.forget("a")
+    assert limiter.when("a") == pytest.approx(0.005)
+
+
+def test_exponential_limiter_caps():
+    limiter = ItemExponentialFailureRateLimiter(base_delay=0.005, max_delay=0.02)
+    for _ in range(10):
+        delay = limiter.when("a")
+    assert delay == pytest.approx(0.02)
+
+
+def test_bucket_limiter_burst_then_throttle():
+    limiter = BucketRateLimiter(qps=10.0, burst=2)
+    assert limiter.when("x") == 0.0
+    assert limiter.when("x") == 0.0
+    assert limiter.when("x") > 0.0  # burst exhausted
+
+
+def test_max_of_rate_limiter():
+    fast = ItemExponentialFailureRateLimiter(base_delay=0.001, max_delay=1)
+    slow = ItemExponentialFailureRateLimiter(base_delay=0.1, max_delay=1)
+    combined = MaxOfRateLimiter(fast, slow)
+    assert combined.when("a") == pytest.approx(0.1)
+    assert combined.num_requeues("a") == 1
+    combined.forget("a")
+    assert combined.num_requeues("a") == 0
+
+
+def test_add_rate_limited_delivers(queue):
+    queue.add_rate_limited("item")
+    item, shutdown = queue.get(timeout=2)
+    assert (item, shutdown) == ("item", False)
