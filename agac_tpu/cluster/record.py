@@ -1,0 +1,65 @@
+"""Event recorder — user-facing observability.
+
+The analog of client-go's ``record.EventRecorder`` that every
+controller in the reference constructs (e.g.
+``pkg/controller/globalaccelerator/controller.go:55-58``) and emits
+through (``GlobalAcceleratorCreated``/``GlobalAcceleratorDeleted``
+events, ``service.go:82,117``).  Events are both logged and persisted
+as ``Event`` objects through the cluster client, so tests and
+operators can list them.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from .. import klog
+from .client import ClusterClient
+from .objects import Event, EventSource, ObjectMeta, ObjectReference
+
+
+class EventRecorder:
+    def __init__(self, client: ClusterClient, component: str):
+        self._client = client
+        self._component = component
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def event(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        meta = obj.metadata
+        ev = Event(
+            metadata=ObjectMeta(
+                name=f"{meta.name}.{seq:x}",
+                namespace=meta.namespace or "default",
+            ),
+            involved_object=ObjectReference(
+                kind=getattr(obj, "KIND", type(obj).__name__),
+                namespace=meta.namespace,
+                name=meta.name,
+                uid=meta.uid,
+            ),
+            reason=reason,
+            message=message,
+            type=event_type,
+            source=EventSource(component=self._component),
+        )
+        klog.infof(
+            'Event(%s/%s %s): type=%r reason=%r %s',
+            meta.namespace,
+            meta.name,
+            ev.involved_object.kind,
+            event_type,
+            reason,
+            message,
+        )
+        try:
+            self._client.create("Event", ev)
+        except Exception as err:
+            klog.errorf("failed to record event %s: %s", reason, err)
+
+    def eventf(self, obj: Any, event_type: str, reason: str, fmt: str, *args) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)

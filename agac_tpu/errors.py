@@ -56,3 +56,13 @@ class NotFoundError(Exception):
 
 def is_not_found(err: BaseException | None) -> bool:
     return isinstance(err, NotFoundError)
+
+
+class ConflictError(Exception):
+    """Optimistic-concurrency conflict: the object's resourceVersion is
+    stale (the apiserver's 409).  Leader election retries on it."""
+
+
+class AlreadyExistsError(Exception):
+    """Create of an object that already exists (the apiserver's 409)."""
+

@@ -25,7 +25,8 @@ _configured = False
 
 
 def init(verbosity: int = 0, stream=None) -> None:
-    """Configure the process-global logger. Safe to call repeatedly."""
+    """Configure the process-global logger.  Safe to call repeatedly;
+    a later call may change verbosity and/or redirect the stream."""
     global _verbosity, _configured
     with _lock:
         _verbosity = verbosity
@@ -41,6 +42,10 @@ def init(verbosity: int = 0, stream=None) -> None:
             _logger.setLevel(logging.DEBUG)
             _logger.propagate = False
             _configured = True
+        elif stream is not None:
+            for handler in _logger.handlers:
+                if isinstance(handler, logging.StreamHandler):
+                    handler.setStream(stream)
 
 
 def verbosity() -> int:

@@ -40,7 +40,10 @@ class ItemExponentialFailureRateLimiter:
         with self._lock:
             failures = self._failures.get(item, 0)
             self._failures[item] = failures + 1
-        delay = self._base * (2**failures)
+        # exponent capped so a persistently failing item can never push
+        # 2**failures past float range (OverflowError would swallow the
+        # requeue entirely)
+        delay = self._base * (2 ** min(failures, 64))
         return min(delay, self._max)
 
     def forget(self, item: Hashable) -> None:

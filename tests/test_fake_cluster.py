@@ -1,0 +1,165 @@
+"""Fake-apiserver semantics tests: CRUD, optimistic concurrency,
+finalizer-aware deletion, generation bookkeeping, watch replay."""
+
+import threading
+
+import pytest
+
+from agac_tpu.cluster import FakeCluster, ObjectMeta, Service
+from agac_tpu.cluster.objects import ServiceSpec
+from agac_tpu.errors import AlreadyExistsError, ConflictError, NotFoundError
+
+
+def make_svc(name="web", ns="default", **meta):
+    return Service(metadata=ObjectMeta(name=name, namespace=ns, **meta))
+
+
+@pytest.fixture
+def cluster():
+    return FakeCluster()
+
+
+def test_create_get_roundtrip(cluster):
+    created = cluster.create("Service", make_svc())
+    assert created.metadata.uid
+    assert created.metadata.resource_version
+    assert created.metadata.generation == 1
+    got = cluster.get("Service", "default", "web")
+    assert got == created
+    assert got is not created  # deep copies, no shared state
+
+
+def test_get_missing_raises_not_found(cluster):
+    with pytest.raises(NotFoundError):
+        cluster.get("Service", "default", "nope")
+
+
+def test_create_duplicate_raises(cluster):
+    cluster.create("Service", make_svc())
+    with pytest.raises(AlreadyExistsError):
+        cluster.create("Service", make_svc())
+
+
+def test_update_bumps_generation_only_on_spec_change(cluster):
+    created = cluster.create("Service", make_svc())
+    created.metadata.annotations["k"] = "v"  # metadata-only change
+    updated = cluster.update("Service", created)
+    assert updated.metadata.generation == 1
+    updated.spec = ServiceSpec(type="LoadBalancer")
+    updated = cluster.update("Service", updated)
+    assert updated.metadata.generation == 2
+
+
+def test_stale_resource_version_conflicts(cluster):
+    created = cluster.create("Service", make_svc())
+    cluster.update("Service", cluster.get("Service", "default", "web"))
+    with pytest.raises(ConflictError):
+        cluster.update("Service", created)  # holds the old rv
+
+
+def test_plain_update_cannot_touch_status(cluster):
+    from agac_tpu.cluster.objects import LoadBalancerIngress
+
+    created = cluster.create("Service", make_svc())
+    created.status.load_balancer.ingress.append(LoadBalancerIngress(hostname="h"))
+    updated = cluster.update("Service", created)
+    assert updated.status.load_balancer.ingress == []
+
+
+def test_update_status_subresource(cluster):
+    from agac_tpu.cluster.objects import LoadBalancerIngress
+
+    created = cluster.create("Service", make_svc())
+    created.status.load_balancer.ingress.append(LoadBalancerIngress(hostname="h"))
+    updated = cluster.update_status("Service", created)
+    assert updated.status.load_balancer.ingress[0].hostname == "h"
+    assert updated.metadata.generation == 1  # status never bumps generation
+
+
+def test_delete_without_finalizers_removes(cluster):
+    cluster.create("Service", make_svc())
+    cluster.delete("Service", "default", "web")
+    with pytest.raises(NotFoundError):
+        cluster.get("Service", "default", "web")
+
+
+def test_delete_with_finalizer_sets_deletion_timestamp(cluster):
+    cluster.create("Service", make_svc(finalizers=["op/f"]))
+    cluster.delete("Service", "default", "web")
+    obj = cluster.get("Service", "default", "web")  # still there
+    assert obj.metadata.deletion_timestamp
+    # clearing the finalizer completes the delete
+    obj.metadata.finalizers = []
+    cluster.update("Service", obj)
+    with pytest.raises(NotFoundError):
+        cluster.get("Service", "default", "web")
+
+
+def test_list_scoped_by_namespace(cluster):
+    cluster.create("Service", make_svc("a", "ns1"))
+    cluster.create("Service", make_svc("b", "ns2"))
+    objs, rv = cluster.list("Service", "ns1")
+    assert [o.metadata.name for o in objs] == ["a"]
+    assert int(rv) >= 2
+    all_objs, _ = cluster.list("Service")
+    assert len(all_objs) == 2
+
+
+def collect_watch(cluster, kind, rv, n, timeout=2.0):
+    """Collect n watch events in a thread."""
+    out = []
+    done = threading.Event()
+
+    def run():
+        for ev in cluster.watch(kind, rv, lambda: done.is_set()):
+            out.append(ev)
+            if len(out) >= n:
+                break
+        done.set()
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(timeout)
+    done.set()
+    t.join(1)
+    return out
+
+
+def test_watch_replays_history_then_streams(cluster):
+    cluster.create("Service", make_svc("one"))
+    _, rv = cluster.list("Service")
+    cluster.create("Service", make_svc("two"))
+
+    out = collect_watch(cluster, "Service", rv, 1)
+    assert [e.type for e in out] == ["ADDED"]
+    assert out[0].obj.metadata.name == "two"
+
+
+def test_watch_from_zero_sees_everything(cluster):
+    cluster.create("Service", make_svc("one"))
+    obj = cluster.get("Service", "default", "one")
+    cluster.update("Service", obj)
+    cluster.delete("Service", "default", "one")
+    out = collect_watch(cluster, "Service", "0", 3)
+    assert [e.type for e in out] == ["ADDED", "MODIFIED", "DELETED"]
+
+
+def test_live_watch_delivery(cluster):
+    out = []
+    got = threading.Event()
+    stop = threading.Event()
+
+    def run():
+        for ev in cluster.watch("Service", "0", lambda: stop.is_set()):
+            out.append(ev)
+            got.set()
+            break
+
+    t = threading.Thread(target=run)
+    t.start()
+    cluster.create("Service", make_svc("live"))
+    assert got.wait(timeout=2)
+    stop.set()
+    t.join(2)
+    assert out[0].type == "ADDED"
+    assert out[0].obj.metadata.name == "live"

@@ -1,0 +1,157 @@
+"""Shared informer/lister/recorder tests: cache sync, handler
+delivery, tombstones on missed deletes, resync re-delivery, factory
+sharing."""
+
+import threading
+import time
+
+import pytest
+
+from agac_tpu.cluster import (
+    EventRecorder,
+    FakeCluster,
+    ObjectMeta,
+    Service,
+    SharedInformerFactory,
+    Tombstone,
+)
+from agac_tpu.errors import NotFoundError
+
+
+def make_svc(name="web", ns="default"):
+    return Service(metadata=ObjectMeta(name=name, namespace=ns))
+
+
+@pytest.fixture
+def cluster():
+    return FakeCluster()
+
+
+@pytest.fixture
+def stop():
+    ev = threading.Event()
+    yield ev
+    ev.set()
+
+
+def wait_until(pred, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_informer_syncs_and_delivers_adds(cluster, stop):
+    cluster.create("Service", make_svc("pre"))
+    factory = SharedInformerFactory(cluster, resync_period=30)
+    informer = factory.informer("Service")
+    adds = []
+    informer.add_event_handler(on_add=lambda o: adds.append(o.metadata.name))
+    factory.start(stop)
+    assert factory.wait_for_cache_sync(stop)
+    assert wait_until(lambda: "pre" in adds)
+
+    cluster.create("Service", make_svc("post"))
+    assert wait_until(lambda: "post" in adds)
+
+
+def test_informer_update_and_delete_delivery(cluster, stop):
+    factory = SharedInformerFactory(cluster, resync_period=30)
+    informer = factory.informer("Service")
+    updates, deletes = [], []
+    informer.add_event_handler(
+        on_update=lambda old, new: updates.append((old.metadata.resource_version, new.metadata.resource_version)),
+        on_delete=lambda o: deletes.append(o),
+    )
+    factory.start(stop)
+    factory.wait_for_cache_sync(stop)
+
+    cluster.create("Service", make_svc())
+    obj = cluster.get("Service", "default", "web")
+    obj.metadata.annotations["x"] = "y"
+    cluster.update("Service", obj)
+    assert wait_until(lambda: len(updates) == 1)
+    old_rv, new_rv = updates[0]
+    assert int(new_rv) > int(old_rv)
+
+    cluster.delete("Service", "default", "web")
+    assert wait_until(lambda: len(deletes) == 1)
+    assert not isinstance(deletes[0], Tombstone)  # live delete has final state
+    assert deletes[0].metadata.name == "web"
+
+
+def test_lister_reads_cache(cluster, stop):
+    cluster.create("Service", make_svc("a", "ns1"))
+    cluster.create("Service", make_svc("b", "ns2"))
+    factory = SharedInformerFactory(cluster, resync_period=30)
+    informer = factory.informer("Service")
+    factory.start(stop)
+    factory.wait_for_cache_sync(stop)
+
+    lister = informer.lister()
+    assert lister.namespaced("ns1").get("a").metadata.name == "a"
+    with pytest.raises(NotFoundError):
+        lister.namespaced("ns1").get("b")
+    assert len(lister.list()) == 2
+    assert [o.metadata.name for o in lister.namespaced("ns2").list()] == ["b"]
+
+
+def test_resync_redelivers_updates(cluster, stop):
+    cluster.create("Service", make_svc())
+    factory = SharedInformerFactory(cluster, resync_period=0.2)
+    informer = factory.informer("Service")
+    updates = []
+    informer.add_event_handler(on_update=lambda old, new: updates.append(new.metadata.name))
+    factory.start(stop)
+    factory.wait_for_cache_sync(stop)
+    # no object changes at all — resync alone must re-deliver
+    assert wait_until(lambda: len(updates) >= 2, timeout=3.0)
+
+
+def test_late_handler_sees_existing_cache(cluster, stop):
+    cluster.create("Service", make_svc("early"))
+    factory = SharedInformerFactory(cluster, resync_period=30)
+    informer = factory.informer("Service")
+    factory.start(stop)
+    factory.wait_for_cache_sync(stop)
+    adds = []
+    informer.add_event_handler(on_add=lambda o: adds.append(o.metadata.name))
+    assert wait_until(lambda: "early" in adds)
+
+
+def test_factory_shares_informers(cluster):
+    factory = SharedInformerFactory(cluster)
+    assert factory.informer("Service") is factory.informer("Service")
+    assert factory.informer("Service") is not factory.informer("Ingress")
+
+
+def test_handler_crash_contained(cluster, stop):
+    factory = SharedInformerFactory(cluster, resync_period=30)
+    informer = factory.informer("Service")
+    seen = []
+
+    def bad_handler(obj):
+        raise RuntimeError("handler bug")
+
+    informer.add_event_handler(on_add=bad_handler)
+    informer.add_event_handler(on_add=lambda o: seen.append(o.metadata.name))
+    factory.start(stop)
+    factory.wait_for_cache_sync(stop)
+    cluster.create("Service", make_svc("x"))
+    assert wait_until(lambda: "x" in seen)  # second handler still runs
+
+
+def test_event_recorder_persists_events(cluster):
+    recorder = EventRecorder(cluster, "test-controller")
+    svc = cluster.create("Service", make_svc())
+    recorder.eventf(svc, "Normal", "GlobalAcceleratorCreated", "Global Accelerator is created: %s", "arn:x")
+    events, _ = cluster.list("Event")
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.reason == "GlobalAcceleratorCreated"
+    assert ev.message == "Global Accelerator is created: arn:x"
+    assert ev.involved_object.kind == "Service"
+    assert ev.involved_object.name == "web"
+    assert ev.source.component == "test-controller"

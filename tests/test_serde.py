@@ -1,0 +1,86 @@
+"""Wire serde round-trip tests (the generated-clients analog,
+SURVEY.md §2 row 17)."""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from agac_tpu.cluster import (
+    Ingress,
+    IngressBackend,
+    IngressServiceBackend,
+    LoadBalancerIngress,
+    ObjectMeta,
+    Service,
+    ServiceBackendPort,
+    ServicePort,
+)
+from agac_tpu.cluster.objects import IngressSpec, ServiceSpec, ServiceStatus, LoadBalancerStatus
+from agac_tpu.cluster.serde import from_wire, to_wire
+
+
+def test_service_round_trip():
+    svc = Service(
+        metadata=ObjectMeta(
+            name="web",
+            namespace="default",
+            annotations={"a": "b"},
+            finalizers=["x"],
+        ),
+        spec=ServiceSpec(
+            type="LoadBalancer",
+            ports=[ServicePort(name="http", port=80, protocol="TCP")],
+            load_balancer_class="service.k8s.aws/nlb",
+        ),
+        status=ServiceStatus(
+            load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(hostname="abc.elb.us-west-2.amazonaws.com")]
+            )
+        ),
+    )
+    wire = to_wire(svc)
+    assert wire["metadata"]["name"] == "web"
+    assert wire["spec"]["loadBalancerClass"] == "service.k8s.aws/nlb"
+    assert wire["spec"]["ports"][0]["port"] == 80
+    assert wire["status"]["loadBalancer"]["ingress"][0]["hostname"].startswith("abc.elb")
+    back = from_wire(Service, wire)
+    assert back == svc
+
+
+def test_omit_empty():
+    svc = Service(metadata=ObjectMeta(name="x"))
+    wire = to_wire(svc)
+    assert "annotations" not in wire["metadata"]
+    assert "deletionTimestamp" not in wire["metadata"]
+    assert "ports" not in wire["spec"]
+
+
+def test_unknown_keys_ignored():
+    wire = {"metadata": {"name": "y", "managedFields": [{"zzz": 1}]}, "futureField": True}
+    svc = from_wire(Service, wire)
+    assert svc.metadata.name == "y"
+
+
+def test_optional_nested():
+    ing = from_wire(
+        Ingress,
+        {
+            "metadata": {"name": "i", "namespace": "default"},
+            "spec": {
+                "ingressClassName": "alb",
+                "defaultBackend": {"service": {"name": "svc", "port": {"number": 8080}}},
+            },
+        },
+    )
+    assert ing.spec.ingress_class_name == "alb"
+    assert ing.spec.default_backend.service.port.number == 8080
+    wire = to_wire(ing)
+    assert wire["spec"]["defaultBackend"]["service"]["port"]["number"] == 8080
+
+
+def test_wire_name_override():
+    @dataclass
+    class Weird:
+        camel_thing: Optional[str] = field(default=None, metadata={"wire": "CamelTHING"})
+
+    assert to_wire(Weird(camel_thing="v")) == {"CamelTHING": "v"}
+    assert from_wire(Weird, {"CamelTHING": "v"}).camel_thing == "v"

@@ -105,7 +105,15 @@ def test_shutdown_unblocks_get(queue):
 def test_add_after_shutdown_is_noop(queue):
     queue.shutdown()
     queue.add("x")
+    queue.add_after("y", 0.01)
+    time.sleep(0.05)
     assert len(queue) == 0
+
+
+def test_persistent_failure_backoff_never_overflows():
+    limiter = ItemExponentialFailureRateLimiter(base_delay=0.005, max_delay=1000.0)
+    limiter._failures["stuck"] = 5000  # simulate ~weeks of failures
+    assert limiter.when("stuck") == 1000.0
 
 
 def test_rate_limited_backoff_grows_and_forget_resets():
