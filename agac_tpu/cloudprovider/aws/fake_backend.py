@@ -1,0 +1,511 @@
+"""In-memory AWS backend implementing all three service interfaces.
+
+The test double the reference never had (SURVEY.md §4: "no fake AWS
+client exists; methods on *AWS* are never unit-tested").  Behaviors
+reproduced because the drivers depend on them:
+
+- **Accelerator status settling**: create/update puts an accelerator
+  into IN_PROGRESS; it becomes DEPLOYED after ``settle_describes``
+  describe/list calls — so the disable → poll-until-DEPLOYED → delete
+  orchestration (reference ``global_accelerator.go:724-765``) is
+  actually exercised by tests.
+- **Deletion ordering constraints**: an enabled accelerator or one
+  with listeners cannot be deleted; a listener with endpoint groups
+  cannot be deleted — making the endpoint-group → listener →
+  accelerator teardown order (``global_accelerator.go:252-270``)
+  observable.
+- **Route53 change batches**: CREATE fails on an existing name+type,
+  DELETE on a missing one, UPSERT always applies; record names are
+  stored dot-terminated with ``*`` escaped as ``\\052`` the way
+  Route53 does (``route53.go:369-371``).
+- **Pagination** on every list operation, honoring max_results.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import uuid
+from typing import Optional
+
+from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
+from .errors import (
+    AWSAPIError,
+    ERR_ACCELERATOR_NOT_DISABLED,
+    ERR_ACCELERATOR_NOT_FOUND,
+    ERR_ASSOCIATED_ENDPOINT_GROUP_FOUND,
+    ERR_ASSOCIATED_LISTENER_FOUND,
+    ERR_INVALID_CHANGE_BATCH,
+    ERR_LOAD_BALANCER_NOT_FOUND,
+    ERR_NO_SUCH_HOSTED_ZONE,
+    EndpointGroupNotFoundException,
+    ListenerNotFoundException,
+)
+from .types import (
+    ACCELERATOR_STATUS_DEPLOYED,
+    ACCELERATOR_STATUS_IN_PROGRESS,
+    CHANGE_ACTION_CREATE,
+    CHANGE_ACTION_DELETE,
+    CHANGE_ACTION_UPSERT,
+    Accelerator,
+    Change,
+    EndpointConfiguration,
+    EndpointDescription,
+    EndpointGroup,
+    HostedZone,
+    Listener,
+    LoadBalancer,
+    PortRange,
+    ResourceRecordSet,
+    Tag,
+)
+
+_ACCOUNT = "123456789012"
+
+
+def _copy_accelerator(a: Accelerator) -> Accelerator:
+    return Accelerator(**vars(a))
+
+
+def _paginate(items: list, max_results: int, next_token: Optional[str]):
+    start = int(next_token) if next_token else 0
+    page = items[start : start + max_results]
+    token = str(start + max_results) if start + max_results < len(items) else None
+    return page, token
+
+
+class _AcceleratorState:
+    def __init__(self, accelerator: Accelerator, tags: list[Tag], settle: int):
+        self.accelerator = accelerator
+        self.tags = tags
+        self.listeners: dict[str, Listener] = {}
+        self.pending_describes = settle  # describes until DEPLOYED
+
+
+class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
+    """One object implements all three services; hand it to the driver
+    as ga_api, elb_api and route53_api."""
+
+    def __init__(self, settle_describes: int = 0):
+        self._lock = threading.RLock()
+        self.settle_describes = settle_describes
+        self._accelerators: dict[str, _AcceleratorState] = {}
+        # listener arn -> (accelerator arn); endpoint groups keyed by arn
+        self._listener_parent: dict[str, str] = {}
+        self._endpoint_groups: dict[str, EndpointGroup] = {}
+        self._eg_parent: dict[str, str] = {}  # eg arn -> listener arn
+        self._load_balancers: dict[str, LoadBalancer] = {}  # name -> LB
+        self._zones: dict[str, HostedZone] = {}  # id -> zone
+        self._records: dict[str, dict[tuple[str, str], ResourceRecordSet]] = {}
+        self._counter = itertools.count(1)
+        # call log for assertions ("CreateAccelerator", arn), ...
+        self.calls: list[tuple] = []
+
+    # ------------------------------------------------------------------
+    # test helpers
+    # ------------------------------------------------------------------
+    def add_load_balancer(
+        self,
+        name: str,
+        region: str,
+        dns_name: str,
+        state_code: str = "active",
+        lb_type: str = "network",
+        scheme: str = "internet-facing",
+    ) -> LoadBalancer:
+        arn = (
+            f"arn:aws:elasticloadbalancing:{region}:{_ACCOUNT}:"
+            f"loadbalancer/{'net' if lb_type == 'network' else 'app'}/{name}/{next(self._counter):016x}"
+        )
+        lb = LoadBalancer(
+            load_balancer_arn=arn,
+            load_balancer_name=name,
+            dns_name=dns_name,
+            state_code=state_code,
+            type=lb_type,
+            scheme=scheme,
+        )
+        with self._lock:
+            self._load_balancers[name] = lb
+        return lb
+
+    def set_load_balancer_state(self, name: str, state_code: str) -> None:
+        with self._lock:
+            self._load_balancers[name].state_code = state_code
+
+    def add_hosted_zone(self, name: str) -> HostedZone:
+        if not name.endswith("."):
+            name += "."
+        zone = HostedZone(id=f"/hostedzone/Z{next(self._counter):08X}", name=name)
+        with self._lock:
+            self._zones[zone.id] = zone
+            self._records.setdefault(zone.id, {})
+        return zone
+
+    def records_in_zone(self, zone_id: str) -> list[ResourceRecordSet]:
+        with self._lock:
+            return list(self._records.get(zone_id, {}).values())
+
+    def all_accelerator_arns(self) -> list[str]:
+        with self._lock:
+            return list(self._accelerators.keys())
+
+    # ------------------------------------------------------------------
+    # GlobalAcceleratorAPI
+    # ------------------------------------------------------------------
+    def _settle(self, state: _AcceleratorState) -> None:
+        if state.pending_describes > 0:
+            state.pending_describes -= 1
+            if state.pending_describes == 0:
+                state.accelerator.status = ACCELERATOR_STATUS_DEPLOYED
+
+    def _get_state(self, arn: str) -> _AcceleratorState:
+        state = self._accelerators.get(arn)
+        if state is None:
+            raise AWSAPIError(ERR_ACCELERATOR_NOT_FOUND, arn)
+        return state
+
+    def list_accelerators(self, max_results, next_token):
+        with self._lock:
+            self.calls.append(("ListAccelerators",))
+            for state in self._accelerators.values():
+                self._settle(state)
+            items = [_copy_accelerator(s.accelerator) for s in self._accelerators.values()]
+            return _paginate(items, max_results, next_token)
+
+    def describe_accelerator(self, arn):
+        with self._lock:
+            self.calls.append(("DescribeAccelerator", arn))
+            state = self._get_state(arn)
+            self._settle(state)
+            return _copy_accelerator(state.accelerator)
+
+    def create_accelerator(self, name, ip_address_type, enabled, tags):
+        with self._lock:
+            arn = f"arn:aws:globalaccelerator::{_ACCOUNT}:accelerator/{uuid.uuid4()}"
+            accelerator = Accelerator(
+                accelerator_arn=arn,
+                name=name,
+                dns_name=f"a{next(self._counter):016x}.awsglobalaccelerator.com",
+                enabled=enabled,
+                status=(
+                    ACCELERATOR_STATUS_IN_PROGRESS
+                    if self.settle_describes
+                    else ACCELERATOR_STATUS_DEPLOYED
+                ),
+                ip_address_type=ip_address_type,
+            )
+            self._accelerators[arn] = _AcceleratorState(
+                accelerator, list(tags), self.settle_describes
+            )
+            self.calls.append(("CreateAccelerator", arn))
+            return _copy_accelerator(accelerator)
+
+    def update_accelerator(self, arn, name=None, enabled=None):
+        with self._lock:
+            state = self._get_state(arn)
+            if name is not None:
+                state.accelerator.name = name
+            if enabled is not None:
+                state.accelerator.enabled = enabled
+            if self.settle_describes:
+                state.accelerator.status = ACCELERATOR_STATUS_IN_PROGRESS
+                state.pending_describes = self.settle_describes
+            self.calls.append(("UpdateAccelerator", arn))
+            return _copy_accelerator(state.accelerator)
+
+    def delete_accelerator(self, arn):
+        with self._lock:
+            state = self._get_state(arn)
+            if state.accelerator.enabled:
+                raise AWSAPIError(
+                    ERR_ACCELERATOR_NOT_DISABLED, "accelerator must be disabled"
+                )
+            if state.listeners:
+                raise AWSAPIError(
+                    ERR_ASSOCIATED_LISTENER_FOUND, "accelerator still has listeners"
+                )
+            del self._accelerators[arn]
+            self.calls.append(("DeleteAccelerator", arn))
+
+    def list_tags_for_resource(self, arn):
+        with self._lock:
+            self.calls.append(("ListTagsForResource", arn))
+            return list(self._get_state(arn).tags)
+
+    def tag_resource(self, arn, tags):
+        with self._lock:
+            state = self._get_state(arn)
+            merged = {t.key: t.value for t in state.tags}
+            merged.update({t.key: t.value for t in tags})
+            state.tags = [Tag(k, v) for k, v in merged.items()]
+            self.calls.append(("TagResource", arn))
+
+    def list_listeners(self, accelerator_arn, max_results, next_token):
+        with self._lock:
+            state = self._get_state(accelerator_arn)
+            items = [
+                Listener(
+                    listener_arn=l.listener_arn,
+                    protocol=l.protocol,
+                    port_ranges=list(l.port_ranges),
+                    client_affinity=l.client_affinity,
+                )
+                for l in state.listeners.values()
+            ]
+            return _paginate(items, max_results, next_token)
+
+    def create_listener(self, accelerator_arn, port_ranges, protocol, client_affinity):
+        with self._lock:
+            state = self._get_state(accelerator_arn)
+            arn = f"{accelerator_arn}/listener/{next(self._counter):08x}"
+            listener = Listener(
+                listener_arn=arn,
+                protocol=protocol,
+                port_ranges=list(port_ranges),
+                client_affinity=client_affinity,
+            )
+            state.listeners[arn] = listener
+            self._listener_parent[arn] = accelerator_arn
+            self.calls.append(("CreateListener", arn))
+            return Listener(**{**vars(listener), "port_ranges": list(port_ranges)})
+
+    def _get_listener(self, listener_arn: str) -> Listener:
+        parent = self._listener_parent.get(listener_arn)
+        if parent is None or parent not in self._accelerators:
+            raise ListenerNotFoundException(listener_arn)
+        return self._accelerators[parent].listeners[listener_arn]
+
+    def update_listener(self, listener_arn, port_ranges, protocol, client_affinity):
+        with self._lock:
+            listener = self._get_listener(listener_arn)
+            listener.port_ranges = list(port_ranges)
+            listener.protocol = protocol
+            listener.client_affinity = client_affinity
+            self.calls.append(("UpdateListener", listener_arn))
+            return Listener(**{**vars(listener), "port_ranges": list(port_ranges)})
+
+    def delete_listener(self, arn):
+        with self._lock:
+            listener = self._get_listener(arn)
+            if any(parent == arn for parent in self._eg_parent.values()):
+                raise AWSAPIError(
+                    ERR_ASSOCIATED_ENDPOINT_GROUP_FOUND,
+                    "listener still has endpoint groups",
+                )
+            parent = self._listener_parent.pop(arn)
+            del self._accelerators[parent].listeners[arn]
+            self.calls.append(("DeleteListener", arn))
+
+    def list_endpoint_groups(self, listener_arn, max_results, next_token):
+        with self._lock:
+            self._get_listener(listener_arn)  # existence check
+            items = [
+                self._copy_eg(eg)
+                for arn, eg in self._endpoint_groups.items()
+                if self._eg_parent[arn] == listener_arn
+            ]
+            return _paginate(items, max_results, next_token)
+
+    @staticmethod
+    def _copy_eg(eg: EndpointGroup) -> EndpointGroup:
+        return EndpointGroup(
+            endpoint_group_arn=eg.endpoint_group_arn,
+            endpoint_group_region=eg.endpoint_group_region,
+            endpoint_descriptions=[
+                EndpointDescription(**vars(d)) for d in eg.endpoint_descriptions
+            ],
+        )
+
+    def describe_endpoint_group(self, arn):
+        with self._lock:
+            eg = self._endpoint_groups.get(arn)
+            if eg is None:
+                raise EndpointGroupNotFoundException(arn)
+            return self._copy_eg(eg)
+
+    def create_endpoint_group(self, listener_arn, endpoint_group_region, endpoint_configurations):
+        with self._lock:
+            self._get_listener(listener_arn)
+            arn = f"{listener_arn}/endpoint-group/{next(self._counter):08x}"
+            eg = EndpointGroup(
+                endpoint_group_arn=arn,
+                endpoint_group_region=endpoint_group_region,
+                endpoint_descriptions=[
+                    EndpointDescription(
+                        endpoint_id=c.endpoint_id,
+                        weight=c.weight,
+                        client_ip_preservation_enabled=c.client_ip_preservation_enabled,
+                    )
+                    for c in endpoint_configurations
+                ],
+            )
+            self._endpoint_groups[arn] = eg
+            self._eg_parent[arn] = listener_arn
+            self.calls.append(("CreateEndpointGroup", arn))
+            return self._copy_eg(eg)
+
+    def update_endpoint_group(self, arn, endpoint_configurations):
+        """UpdateEndpointGroup treats the configuration list as the
+        COMPLETE desired endpoint set (real AWS semantics) — callers
+        updating one endpoint must send all of them."""
+        with self._lock:
+            eg = self._endpoint_groups.get(arn)
+            if eg is None:
+                raise EndpointGroupNotFoundException(arn)
+            eg.endpoint_descriptions = [
+                EndpointDescription(
+                    endpoint_id=c.endpoint_id,
+                    weight=c.weight,
+                    client_ip_preservation_enabled=c.client_ip_preservation_enabled,
+                )
+                for c in endpoint_configurations
+            ]
+            self.calls.append(("UpdateEndpointGroup", arn))
+            return self._copy_eg(eg)
+
+    def delete_endpoint_group(self, arn):
+        with self._lock:
+            if arn not in self._endpoint_groups:
+                raise EndpointGroupNotFoundException(arn)
+            del self._endpoint_groups[arn]
+            del self._eg_parent[arn]
+            self.calls.append(("DeleteEndpointGroup", arn))
+
+    def add_endpoints(self, arn, endpoint_configurations):
+        with self._lock:
+            eg = self._endpoint_groups.get(arn)
+            if eg is None:
+                raise EndpointGroupNotFoundException(arn)
+            added = []
+            for c in endpoint_configurations:
+                desc = EndpointDescription(
+                    endpoint_id=c.endpoint_id,
+                    weight=c.weight,
+                    client_ip_preservation_enabled=c.client_ip_preservation_enabled,
+                )
+                existing = [d for d in eg.endpoint_descriptions if d.endpoint_id == c.endpoint_id]
+                if existing:
+                    existing[0].weight = c.weight
+                    existing[0].client_ip_preservation_enabled = c.client_ip_preservation_enabled
+                    added.append(existing[0])
+                else:
+                    eg.endpoint_descriptions.append(desc)
+                    added.append(desc)
+            self.calls.append(("AddEndpoints", arn))
+            return [EndpointDescription(**vars(d)) for d in added]
+
+    def remove_endpoints(self, arn, endpoint_ids):
+        with self._lock:
+            eg = self._endpoint_groups.get(arn)
+            if eg is None:
+                raise EndpointGroupNotFoundException(arn)
+            eg.endpoint_descriptions = [
+                d for d in eg.endpoint_descriptions if d.endpoint_id not in endpoint_ids
+            ]
+            self.calls.append(("RemoveEndpoints", arn))
+
+    # ------------------------------------------------------------------
+    # ELBv2API
+    # ------------------------------------------------------------------
+    def describe_load_balancers(self, names):
+        with self._lock:
+            found = [
+                LoadBalancer(**vars(self._load_balancers[n]))
+                for n in names
+                if n in self._load_balancers
+            ]
+            if not found:
+                raise AWSAPIError(
+                    ERR_LOAD_BALANCER_NOT_FOUND,
+                    f"Load balancers '{names}' not found",
+                )
+            return found
+
+    # ------------------------------------------------------------------
+    # Route53API
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _wire_name(name: str) -> str:
+        """Route53 stores names dot-terminated with ``*`` as ``\\052``."""
+        if not name.endswith("."):
+            name += "."
+        return name.replace("*", "\\052", 1)
+
+    def list_hosted_zones(self, max_items, marker):
+        with self._lock:
+            zones = sorted(self._zones.values(), key=lambda z: z.name)
+            return _paginate([HostedZone(**vars(z)) for z in zones], max_items, marker)
+
+    def list_hosted_zones_by_name(self, dns_name, max_items):
+        """Lexicographic from ``dns_name`` onward, like the real API."""
+        if not dns_name.endswith("."):
+            dns_name += "."
+        with self._lock:
+            # Route53 orders by reversed-label DNS name; plain name sort
+            # is enough for the "does an exact zone exist" probe the
+            # driver performs (reference route53.go:337-357).
+            zones = sorted(self._zones.values(), key=lambda z: z.name)
+            after = [HostedZone(**vars(z)) for z in zones if z.name >= dns_name]
+            return after[:max_items]
+
+    @staticmethod
+    def _copy_rrs(r: ResourceRecordSet) -> ResourceRecordSet:
+        from .types import AliasTarget, ResourceRecord
+
+        return ResourceRecordSet(
+            name=r.name,
+            type=r.type,
+            ttl=r.ttl,
+            resource_records=[ResourceRecord(rr.value) for rr in r.resource_records],
+            alias_target=AliasTarget(**vars(r.alias_target)) if r.alias_target else None,
+        )
+
+    def list_resource_record_sets(self, hosted_zone_id, max_items, start_record_name):
+        with self._lock:
+            if hosted_zone_id not in self._zones:
+                raise AWSAPIError(ERR_NO_SUCH_HOSTED_ZONE, hosted_zone_id)
+            records = sorted(
+                self._records[hosted_zone_id].values(), key=lambda r: (r.name, r.type)
+            )
+            items = [self._copy_rrs(r) for r in records]
+            return _paginate(items, max_items, start_record_name)
+
+    def change_resource_record_sets(self, hosted_zone_id, changes: list[Change]):
+        with self._lock:
+            if hosted_zone_id not in self._zones:
+                raise AWSAPIError(ERR_NO_SUCH_HOSTED_ZONE, hosted_zone_id)
+            table = self._records[hosted_zone_id]
+            # validate the whole batch first: Route53 batches are atomic
+            for change in changes:
+                record = change.record_set
+                key = (self._wire_name(record.name), record.type)
+                if change.action == CHANGE_ACTION_CREATE and key in table:
+                    raise AWSAPIError(
+                        ERR_INVALID_CHANGE_BATCH,
+                        f"record {key} already exists",
+                    )
+                if change.action == CHANGE_ACTION_DELETE and key not in table:
+                    raise AWSAPIError(
+                        ERR_INVALID_CHANGE_BATCH,
+                        f"record {key} does not exist",
+                    )
+                if change.action not in (
+                    CHANGE_ACTION_CREATE,
+                    CHANGE_ACTION_DELETE,
+                    CHANGE_ACTION_UPSERT,
+                ):
+                    raise AWSAPIError(ERR_INVALID_CHANGE_BATCH, change.action)
+            for change in changes:
+                record = self._copy_rrs(change.record_set)
+                record.name = self._wire_name(record.name)
+                if record.alias_target and not record.alias_target.dns_name.endswith("."):
+                    # Route53 returns alias DNSNames dot-terminated
+                    # regardless of how they were submitted
+                    record.alias_target.dns_name += "."
+                key = (record.name, record.type)
+                if change.action == CHANGE_ACTION_DELETE:
+                    del table[key]
+                else:
+                    table[key] = record
+            self.calls.append(("ChangeResourceRecordSets", hosted_zone_id))

@@ -74,13 +74,14 @@ class SharedInformer:
         on_delete: Optional[Callable[[Any], None]] = None,
     ) -> None:
         handler = _Handler(on_add, on_update, on_delete)
+        # enqueue the synthetic adds while still holding the lock:
+        # store mutation, handler snapshot and delta enqueue must be
+        # atomic or a concurrently applied watch event can reach the
+        # new handler before its (staler) synthetic add
         with self._lock:
             self._handlers.append(handler)
-            existing = list(self._store.values())
-        # late registrations see the current cache as synthetic adds,
-        # like client-go
-        for obj in existing:
-            self._deltas.put(("add", None, obj, [handler]))
+            for obj in self._store.values():
+                self._deltas.put(("add", None, obj, [handler]))
 
     def has_synced(self) -> bool:
         return self._synced.is_set()
@@ -138,16 +139,16 @@ class SharedInformer:
             old = self._store
             self._store = fresh
             handlers = list(self._handlers)
-        for key, obj in fresh.items():
-            if key in old:
-                # resync: re-deliver as update(old, new) even if equal —
-                # the level-trigger safety net
-                self._deltas.put(("update", old[key], obj, handlers))
-            else:
-                self._deltas.put(("add", None, obj, handlers))
-        for key, obj in old.items():
-            if key not in fresh:
-                self._deltas.put(("delete", None, Tombstone(key, obj), handlers))
+            for key, obj in fresh.items():
+                if key in old:
+                    # resync: re-deliver as update(old, new) even if
+                    # equal — the level-trigger safety net
+                    self._deltas.put(("update", old[key], obj, handlers))
+                else:
+                    self._deltas.put(("add", None, obj, handlers))
+            for key, obj in old.items():
+                if key not in fresh:
+                    self._deltas.put(("delete", None, Tombstone(key, obj), handlers))
         return rv
 
     def _apply(self, event_type: str, obj: Any) -> None:
@@ -159,12 +160,12 @@ class SharedInformer:
             else:
                 self._store[key] = obj
             handlers = list(self._handlers)
-        if event_type == "DELETED":
-            self._deltas.put(("delete", None, obj, handlers))
-        elif old is None:
-            self._deltas.put(("add", None, obj, handlers))
-        else:
-            self._deltas.put(("update", old, obj, handlers))
+            if event_type == "DELETED":
+                self._deltas.put(("delete", None, obj, handlers))
+            elif old is None:
+                self._deltas.put(("add", None, obj, handlers))
+            else:
+                self._deltas.put(("update", old, obj, handlers))
 
     def _dispatch_loop(self, stop: threading.Event) -> None:
         while not stop.is_set():

@@ -11,7 +11,7 @@ operators can list them.
 
 from __future__ import annotations
 
-import threading
+import time
 from typing import Any
 
 from .. import klog
@@ -23,17 +23,14 @@ class EventRecorder:
     def __init__(self, client: ClusterClient, component: str):
         self._client = client
         self._component = component
-        self._seq = 0
-        self._lock = threading.Lock()
 
     def event(self, obj: Any, event_type: str, reason: str, message: str) -> None:
-        with self._lock:
-            self._seq += 1
-            seq = self._seq
         meta = obj.metadata
+        # unique across recorder instances and process restarts, like
+        # client-go's UnixNano suffix
         ev = Event(
             metadata=ObjectMeta(
-                name=f"{meta.name}.{seq:x}",
+                name=f"{meta.name}.{time.time_ns():x}",
                 namespace=meta.namespace or "default",
             ),
             involved_object=ObjectReference(

@@ -1,0 +1,99 @@
+"""Shared object builders — the analog of the reference's
+``pkg/fixture`` and ``local_e2e/pkg/fixtures`` packages."""
+
+from __future__ import annotations
+
+from agac_tpu import apis
+from agac_tpu.cluster import ObjectMeta, Service, ServicePort
+from agac_tpu.cluster.objects import (
+    HTTPIngressPath,
+    HTTPIngressRuleValue,
+    Ingress,
+    IngressBackend,
+    IngressLoadBalancerIngress,
+    IngressRule,
+    IngressServiceBackend,
+    IngressSpec,
+    LoadBalancerIngress,
+    ServiceBackendPort,
+    ServiceSpec,
+)
+
+NLB_HOSTNAME = "testlb-0123456789abcdef.elb.us-west-2.amazonaws.com"
+NLB_NAME = "testlb"
+NLB_REGION = "us-west-2"
+
+ALB_HOSTNAME = "k8s-default-testing-0a1b2c3d4e-111222333.us-west-2.elb.amazonaws.com"
+ALB_NAME = "k8s-default-testing-0a1b2c3d4e"
+
+
+def make_lb_service(
+    name="web",
+    ns="default",
+    managed=True,
+    hostname=NLB_HOSTNAME,
+    ports=((80, "TCP"),),
+    annotations=None,
+):
+    """An NLB Service like the reference's e2e fixture
+    (``local_e2e/pkg/fixtures/service.go:10-51``)."""
+    meta_annotations = {apis.AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external"}
+    if managed:
+        meta_annotations[apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION] = "true"
+    meta_annotations.update(annotations or {})
+    svc = Service(
+        metadata=ObjectMeta(name=name, namespace=ns, annotations=meta_annotations),
+        spec=ServiceSpec(
+            type="LoadBalancer",
+            ports=[ServicePort(name=f"p{port}", port=port, protocol=proto) for port, proto in ports],
+        ),
+    )
+    if hostname:
+        svc.status.load_balancer.ingress.append(LoadBalancerIngress(hostname=hostname))
+    return svc
+
+
+def make_alb_ingress(
+    name="webapp",
+    ns="default",
+    managed=True,
+    hostname=ALB_HOSTNAME,
+    rule_ports=(80,),
+    annotations=None,
+):
+    """An ALB Ingress like the reference's e2e fixture
+    (``local_e2e/pkg/fixtures/ingress.go:15-58``)."""
+    meta_annotations = {apis.INGRESS_CLASS_ANNOTATION: "alb"}
+    if managed:
+        meta_annotations[apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION] = "true"
+    meta_annotations.update(annotations or {})
+    ing = Ingress(
+        metadata=ObjectMeta(name=name, namespace=ns, annotations=meta_annotations),
+        spec=IngressSpec(
+            ingress_class_name="alb",
+            rules=[
+                IngressRule(
+                    host="app.example.com",
+                    http=HTTPIngressRuleValue(
+                        paths=[
+                            HTTPIngressPath(
+                                path="/",
+                                backend=IngressBackend(
+                                    service=IngressServiceBackend(
+                                        name="backend",
+                                        port=ServiceBackendPort(number=p),
+                                    )
+                                ),
+                            )
+                            for p in rule_ports
+                        ]
+                    ),
+                )
+            ],
+        ),
+    )
+    if hostname:
+        ing.status.load_balancer.ingress.append(
+            IngressLoadBalancerIngress(hostname=hostname)
+        )
+    return ing

@@ -1,0 +1,202 @@
+"""Global Accelerator pure-helper tests, mirroring the reference's
+``pkg/cloudprovider/aws/global_accelerator_test.go`` tables (listener
+protocol/port drift, listener derivation incl. the ALB listen-ports
+annotation) plus tag/name helpers."""
+
+import pytest
+
+from agac_tpu import apis
+from agac_tpu.cluster import (
+    Ingress,
+    IngressBackend,
+    IngressServiceBackend,
+    ObjectMeta,
+    Service,
+    ServiceBackendPort,
+    ServicePort,
+)
+from agac_tpu.cluster.objects import (
+    HTTPIngressPath,
+    HTTPIngressRuleValue,
+    IngressRule,
+    IngressSpec,
+    ServiceSpec,
+)
+from agac_tpu.cloudprovider.aws import EndpointGroup, Listener, LoadBalancer, PortRange
+from agac_tpu.cloudprovider.aws.driver import (
+    accelerator_name,
+    accelerator_tags_from_annotations,
+    endpoint_contains_lb,
+    listener_for_ingress,
+    listener_for_service,
+    listener_port_changed_from_service,
+    listener_protocol_changed_from_ingress,
+    listener_protocol_changed_from_service,
+    tags_contains_all_values,
+)
+from agac_tpu.cloudprovider.aws.types import EndpointDescription, Tag
+
+
+def svc_with_ports(*ports):
+    return Service(
+        metadata=ObjectMeta(name="svc", namespace="default"),
+        spec=ServiceSpec(
+            type="LoadBalancer",
+            ports=[ServicePort(name=f"p{i}", protocol=proto, port=port) for i, (proto, port) in enumerate(ports)],
+        ),
+    )
+
+
+class TestListenerProtocolChanged:
+    def test_unchanged_single_udp(self):
+        listener = Listener(listener_arn="sample", protocol="UDP")
+        assert not listener_protocol_changed_from_service(listener, svc_with_ports(("UDP", 53)))
+
+    def test_unchanged_multiple_tcp(self):
+        listener = Listener(listener_arn="sample", protocol="TCP")
+        assert not listener_protocol_changed_from_service(
+            listener, svc_with_ports(("TCP", 80), ("TCP", 443))
+        )
+
+    def test_unchanged_mixed_protocols_last_wins(self):
+        # [UDP, TCP] resolves to TCP (the reference's loop keeps the
+        # last port's protocol, global_accelerator.go:498-510)
+        listener = Listener(listener_arn="sample", protocol="TCP")
+        assert not listener_protocol_changed_from_service(
+            listener, svc_with_ports(("UDP", 53), ("TCP", 80))
+        )
+
+    def test_changed_single(self):
+        listener = Listener(listener_arn="sample", protocol="TCP")
+        assert listener_protocol_changed_from_service(listener, svc_with_ports(("UDP", 53)))
+
+    def test_changed_multiple_udp(self):
+        listener = Listener(listener_arn="sample", protocol="TCP")
+        assert listener_protocol_changed_from_service(
+            listener, svc_with_ports(("UDP", 53), ("UDP", 123))
+        )
+
+    def test_ingress_listener_must_be_tcp(self):
+        ing = Ingress(metadata=ObjectMeta(name="i", namespace="default"))
+        assert listener_protocol_changed_from_ingress(Listener(protocol="UDP"), ing)
+        assert not listener_protocol_changed_from_ingress(Listener(protocol="TCP"), ing)
+
+
+class TestListenerPortChanged:
+    def listener(self, *ports):
+        return Listener(port_ranges=[PortRange(p, p) for p in ports])
+
+    def test_unchanged(self):
+        assert not listener_port_changed_from_service(
+            self.listener(80, 443), svc_with_ports(("TCP", 80), ("TCP", 443))
+        )
+
+    def test_port_added(self):
+        assert listener_port_changed_from_service(
+            self.listener(80), svc_with_ports(("TCP", 80), ("TCP", 443))
+        )
+
+    def test_port_removed(self):
+        assert listener_port_changed_from_service(
+            self.listener(80, 443), svc_with_ports(("TCP", 80))
+        )
+
+    def test_port_swapped(self):
+        assert listener_port_changed_from_service(
+            self.listener(80), svc_with_ports(("TCP", 8080))
+        )
+
+
+class TestListenerForService:
+    def test_ports_and_protocol(self):
+        ports, protocol = listener_for_service(svc_with_ports(("TCP", 80), ("TCP", 443)))
+        assert ports == [80, 443]
+        assert protocol == "TCP"
+
+    def test_udp(self):
+        ports, protocol = listener_for_service(svc_with_ports(("UDP", 53)))
+        assert ports == [53]
+        assert protocol == "UDP"
+
+
+class TestListenerForIngress:
+    def make_ingress(self, annotations=None, default_port=None, rule_ports=()):
+        spec = IngressSpec()
+        if default_port:
+            spec.default_backend = IngressBackend(
+                service=IngressServiceBackend(name="d", port=ServiceBackendPort(number=default_port))
+            )
+        if rule_ports:
+            spec.rules = [
+                IngressRule(
+                    host="example.com",
+                    http=HTTPIngressRuleValue(
+                        paths=[
+                            HTTPIngressPath(
+                                path="/",
+                                backend=IngressBackend(
+                                    service=IngressServiceBackend(
+                                        name="s", port=ServiceBackendPort(number=p)
+                                    )
+                                ),
+                            )
+                            for p in rule_ports
+                        ]
+                    ),
+                )
+            ]
+        return Ingress(
+            metadata=ObjectMeta(name="ing", namespace="default", annotations=annotations or {}),
+            spec=spec,
+        )
+
+    def test_listen_ports_annotation_wins(self):
+        ing = self.make_ingress(
+            annotations={apis.ALB_LISTEN_PORTS_ANNOTATION: '[{"HTTP": 80}, {"HTTPS": 443}]'},
+            rule_ports=(8080,),
+        )
+        ports, protocol = listener_for_ingress(ing)
+        assert ports == [80, 443]
+        assert protocol == "TCP"
+
+    def test_bad_annotation_json_yields_empty(self):
+        ing = self.make_ingress(
+            annotations={apis.ALB_LISTEN_PORTS_ANNOTATION: "not-json"}, rule_ports=(8080,)
+        )
+        ports, _ = listener_for_ingress(ing)
+        assert ports == []
+
+    def test_default_backend_and_rules(self):
+        ing = self.make_ingress(default_port=9000, rule_ports=(80, 8080))
+        ports, _ = listener_for_ingress(ing)
+        assert ports == [9000, 80, 8080]
+
+
+def test_endpoint_contains_lb():
+    lb = LoadBalancer(load_balancer_arn="arn:aws:elb:us-west-2::lb/x")
+    eg = EndpointGroup(endpoint_descriptions=[EndpointDescription(endpoint_id="arn:aws:elb:us-west-2::lb/x")])
+    assert endpoint_contains_lb(eg, lb)
+    assert not endpoint_contains_lb(EndpointGroup(), lb)
+
+
+def test_tags_contains_all_values():
+    tags = [Tag("a", "1"), Tag("b", "2"), Tag("extra", "x")]
+    assert tags_contains_all_values(tags, {"a": "1", "b": "2"})
+    assert not tags_contains_all_values(tags, {"a": "1", "missing": "z"})
+    assert not tags_contains_all_values(tags, {"a": "wrong"})
+
+
+def test_accelerator_name_annotation_override():
+    svc = svc_with_ports(("TCP", 80))
+    assert accelerator_name("service", svc) == "service-default-svc"
+    svc.metadata.annotations[apis.AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION] = "custom"
+    assert accelerator_name("service", svc) == "custom"
+
+
+def test_accelerator_tags_parse_skips_malformed():
+    svc = svc_with_ports(("TCP", 80))
+    svc.metadata.annotations[apis.AWS_GLOBAL_ACCELERATOR_TAGS_ANNOTATION] = (
+        "env=prod,bad,team=infra,also=bad=worse"
+    )
+    tags = accelerator_tags_from_annotations(svc)
+    assert [(t.key, t.value) for t in tags] == [("env", "prod"), ("team", "infra")]

@@ -1,0 +1,61 @@
+"""LB hostname parsing — the 4-shape contract from the reference's
+``pkg/cloudprovider/aws/load_balancer_test.go:9-50`` plus provider
+detection (``provider_test.go``)."""
+
+import pytest
+
+from agac_tpu.cloudprovider import detect_cloud_provider
+from agac_tpu.cloudprovider.aws import get_lb_name_from_hostname, get_region_from_arn
+
+
+@pytest.mark.parametrize(
+    "title,hostname,expected_name,expected_region",
+    [
+        (
+            "public NLB",
+            "aa5849cde256f49faa7487bb433155b7-3f43353a6cb6f633.elb.ap-northeast-1.amazonaws.com",
+            "aa5849cde256f49faa7487bb433155b7",
+            "ap-northeast-1",
+        ),
+        (
+            "internal NLB",
+            "test-b6cdc5fbd1d6fa43.elb.ap-northeast-1.amazonaws.com",
+            "test",
+            "ap-northeast-1",
+        ),
+        (
+            "public ALB",
+            "k8s-default-h3poteto-f1f41628db-201899272.ap-northeast-1.elb.amazonaws.com",
+            "k8s-default-h3poteto-f1f41628db",
+            "ap-northeast-1",
+        ),
+        (
+            "internal ALB",
+            "internal-k8s-default-h3poteto-35ca57562f-777774719.ap-northeast-1.elb.amazonaws.com",
+            "k8s-default-h3poteto-35ca57562f",
+            "ap-northeast-1",
+        ),
+    ],
+)
+def test_get_lb_name_from_hostname(title, hostname, expected_name, expected_region):
+    name, region = get_lb_name_from_hostname(hostname)
+    assert name == expected_name
+    assert region == expected_region
+
+
+def test_non_elb_hostname_rejected():
+    with pytest.raises(ValueError, match="is not Elastic Load Balancer"):
+        get_lb_name_from_hostname("example.cloudfront.net")
+
+
+def test_get_region_from_arn():
+    arn = "arn:aws:elasticloadbalancing:us-west-2:123456789012:loadbalancer/net/foo/abc"
+    assert get_region_from_arn(arn) == "us-west-2"
+
+
+def test_detect_cloud_provider():
+    assert (
+        detect_cloud_provider("abc-123.elb.us-west-2.amazonaws.com") == "aws"
+    )
+    with pytest.raises(ValueError, match="Unknown cloud provider"):
+        detect_cloud_provider("foo.azure.example.net")

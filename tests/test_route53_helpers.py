@@ -1,0 +1,88 @@
+"""Route53 pure-helper tests, mirroring the reference's
+``pkg/cloudprovider/aws/route53_test.go`` tables."""
+
+import pytest
+
+from agac_tpu.cloudprovider.aws import Accelerator, AliasTarget, ResourceRecordSet
+from agac_tpu.cloudprovider.aws.driver import (
+    Route53OwnerValue,
+    find_a_record,
+    need_records_update,
+    parent_domain,
+    replace_wildcards,
+)
+
+
+class TestFindARecord:
+    def test_no_a_record(self):
+        records = [
+            ResourceRecordSet(name="foo.example.com.", type="CNAME"),
+            ResourceRecordSet(name="bar.example.com.", type="CNAME"),
+        ]
+        assert find_a_record(records, "foo.example.com") is None
+
+    def test_hostname_absent(self):
+        records = [
+            ResourceRecordSet(name="foo.example.com.", type="A"),
+            ResourceRecordSet(name="bar.example.com.", type="A"),
+        ]
+        assert find_a_record(records, "baz.example.com") is None
+
+    def test_hostname_present(self):
+        records = [
+            ResourceRecordSet(name="foo.example.com.", type="A"),
+            ResourceRecordSet(name="bar.example.com.", type="A"),
+        ]
+        assert find_a_record(records, "bar.example.com") is records[1]
+
+    def test_wildcard_record(self):
+        records = [
+            ResourceRecordSet(name="\\052.example.com.", type="A"),
+            ResourceRecordSet(name="bar.example.com.", type="A"),
+        ]
+        assert find_a_record(records, "*.example.com") is records[0]
+
+
+class TestNeedRecordsUpdate:
+    def test_alias_nil(self):
+        record = ResourceRecordSet(name="foo.example.com")
+        assert need_records_update(record, Accelerator())
+
+    def test_alias_dns_mismatch(self):
+        record = ResourceRecordSet(
+            name="foo.example.com",
+            alias_target=AliasTarget(dns_name="foo.example.com."),
+        )
+        assert need_records_update(record, Accelerator(dns_name="bar.example.com"))
+
+    def test_alias_dns_matches(self):
+        record = ResourceRecordSet(
+            name="foo.example.com",
+            alias_target=AliasTarget(dns_name="foo.example.com."),
+        )
+        assert not need_records_update(record, Accelerator(dns_name="foo.example.com"))
+
+
+@pytest.mark.parametrize(
+    "hostname,expected",
+    [
+        ("h3poteto-test.example.com", "example.com"),
+        ("h3poteto-test.foo.example.com", "foo.example.com"),
+        ("example.com", "com"),
+        ("com", ""),
+        (".", ""),
+    ],
+)
+def test_parent_domain(hostname, expected):
+    assert parent_domain(hostname) == expected
+
+
+def test_owner_value_format():
+    assert Route53OwnerValue("prod", "service", "default", "web") == (
+        '"heritage=aws-global-accelerator-controller,cluster=prod,service/default/web"'
+    )
+
+
+def test_replace_wildcards_only_first():
+    assert replace_wildcards("\\052.example.com.") == "*.example.com."
+    assert replace_wildcards("plain.example.com.") == "plain.example.com."
