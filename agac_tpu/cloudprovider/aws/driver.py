@@ -274,6 +274,8 @@ class AWSDriver:
         poll_interval: float = 10.0,
         poll_timeout: float = 180.0,
         sleep: Callable[[float], None] = time.sleep,
+        lb_not_active_retry: float = LB_NOT_ACTIVE_RETRY,
+        accelerator_missing_retry: float = ACCELERATOR_MISSING_RETRY,
     ):
         self.ga = ga
         self.elbv2 = elbv2
@@ -281,6 +283,8 @@ class AWSDriver:
         self._poll_interval = poll_interval
         self._poll_timeout = poll_timeout
         self._sleep = sleep
+        self._lb_not_active_retry = lb_not_active_retry
+        self._accelerator_missing_retry = accelerator_missing_retry
 
     # ------------------------------------------------------------------
     # ELBv2
@@ -398,7 +402,7 @@ class AWSDriver:
             klog.warningf(
                 "LoadBalancer %s is not Active: %s", lb.load_balancer_arn, lb.state_code
             )
-            return None, False, LB_NOT_ACTIVE_RETRY
+            return None, False, self._lb_not_active_retry
 
         klog.infof("LoadBalancer is %s", lb.load_balancer_arn)
         ns, name = obj.metadata.namespace, obj.metadata.name
@@ -700,7 +704,7 @@ class AWSDriver:
             klog.warningf(
                 "LoadBalancer %s is not Active: %s", lb.load_balancer_arn, lb.state_code
             )
-            return None, LB_NOT_ACTIVE_RETRY
+            return None, self._lb_not_active_retry
         added = self.ga.add_endpoints(
             endpoint_group.endpoint_group_arn,
             [
@@ -784,10 +788,10 @@ class AWSDriver:
         if len(accelerators) > 1:
             klog.v(4).infof("Found many Global Accelerators: %r", accelerators)
             klog.errorf("Too many Global Accelerators for %s", lb_hostname)
-            return False, ACCELERATOR_MISSING_RETRY
+            return False, self._accelerator_missing_retry
         if not accelerators:
             klog.errorf("Could not find Global Accelerator for %s", lb_hostname)
-            return False, ACCELERATOR_MISSING_RETRY
+            return False, self._accelerator_missing_retry
         accelerator = accelerators[0]
 
         owner_value = Route53OwnerValue(cluster_name, resource, ns, name)

@@ -1,0 +1,111 @@
+"""Shared controller machinery: predicates, tombstone unwrapping,
+worker pools, and the cloud-factory seam.
+
+The predicates replicate the reference's event filters:
+``wasLoadBalancerService`` (``pkg/controller/globalaccelerator/service.go:18-26``),
+``wasALBIngress`` (``ingress.go:19-27``), ``hasManagedAnnotation`` /
+``managedAnnotationChanged`` (``controller.go:250-259``) and the
+Route53 hostname-annotation pair (``route53/controller.go:243-252``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+from .. import apis, klog
+from ..cloudprovider.aws import AWSDriver
+from ..cluster.informer import Tombstone
+from ..reconcile import RateLimitingQueue, process_next_work_item
+
+# One driver per region; GA/Route53 are global services pinned to
+# us-west-2 in the reference (``pkg/cloudprovider/aws/aws.go:26-32``).
+CloudFactory = Callable[[str], AWSDriver]
+GLOBAL_REGION = "us-west-2"
+
+
+def default_cloud_factory(region: str) -> AWSDriver:
+    """Placeholder until a process wires a real backend; controllers
+    always accept an injected factory (the testability seam the
+    reference lacks, SURVEY.md §7 stage 3)."""
+    raise RuntimeError(
+        "no cloud factory configured: pass cloud_factory= to the controller "
+        "(e.g. one backed by FakeAWSBackend, or a real AWS backend)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# predicates
+# ---------------------------------------------------------------------------
+
+
+def was_load_balancer_service(svc) -> bool:
+    if svc.spec.type != "LoadBalancer":
+        return False
+    return (
+        apis.AWS_LOAD_BALANCER_TYPE_ANNOTATION in svc.metadata.annotations
+        or svc.spec.load_balancer_class is not None
+    )
+
+
+def was_alb_ingress(ingress) -> bool:
+    if ingress.spec.ingress_class_name == "alb":
+        return True
+    return apis.INGRESS_CLASS_ANNOTATION in ingress.metadata.annotations
+
+
+def has_annotation(obj, annotation: str) -> bool:
+    return annotation in obj.metadata.annotations
+
+
+def annotation_changed(old, new, annotation: str) -> bool:
+    return (annotation in old.metadata.annotations) != (
+        annotation in new.metadata.annotations
+    )
+
+
+def unwrap_tombstone(obj: Any) -> Optional[Any]:
+    """Deletions observed via relist arrive as Tombstones carrying the
+    last known state (``cache.DeletedFinalStateUnknown`` handling,
+    reference ``globalaccelerator/controller.go:113-127``)."""
+    if isinstance(obj, Tombstone):
+        if obj.obj is None:
+            klog.errorf("error decoding object tombstone for %s", obj.key)
+            return None
+        klog.v(4).infof("Recovered deleted object %r from tombstone", obj.key)
+        return obj.obj
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# worker pool
+# ---------------------------------------------------------------------------
+
+
+def run_workers(
+    name: str,
+    queue: RateLimitingQueue,
+    threadiness: int,
+    stop: threading.Event,
+    key_to_obj,
+    process_delete,
+    process_create_or_update,
+) -> list[threading.Thread]:
+    """Launch ``threadiness`` worker threads looping
+    ``process_next_work_item`` until queue shutdown (the analog of
+    ``wait.Until(runWorker, time.Second, stopCh)``,
+    reference ``globalaccelerator/controller.go:206-211``)."""
+
+    def loop():
+        while process_next_work_item(
+            queue, key_to_obj, process_delete, process_create_or_update
+        ):
+            if stop.is_set():
+                break
+
+    threads = []
+    for i in range(threadiness):
+        t = threading.Thread(target=loop, daemon=True, name=f"{name}-worker-{i}")
+        t.start()
+        threads.append(t)
+    return threads

@@ -1,0 +1,261 @@
+"""The EndpointGroupBinding controller — the CRD's finalizer state
+machine.
+
+Capability parity with the reference's
+``pkg/controller/endpointgroupbinding/`` (439 LoC):
+
+- create → install the finalizer (``reconcile.go:99-110``);
+- update → resolve the referenced Service/Ingress to LB ARNs through
+  the listers + ELBv2 (``reconcile.go:219-252``), diff against
+  ``status.endpointIds``, add/remove endpoints, sync weights, then
+  update status with the new ids and ObservedGeneration
+  (``reconcile.go:112-217``);
+- delete → remove all endpoints (tolerating a vanished endpoint group
+  via the ``EndpointGroupNotFoundException`` error code,
+  ``reconcile.go:48-64``), then clear the finalizer so the apiserver
+  completes the deletion; a 1 s requeue drives the loop
+  (``reconcile.go:96``).
+
+ARN-change update events are dropped at the handler (belt-and-braces
+with the validating webhook, ``controller.go:84-94``).
+
+The reference's delete loop mutates ``endpointIds`` while iterating by
+index (``reconcile.go:71-85``, flagged in SURVEY.md §7 as a known
+bug); the intent — remove every endpoint, persist the emptied status,
+requeue — is implemented here without the index dance.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import klog
+from ..apis.endpointgroupbinding import FINALIZER, EndpointGroupBinding
+from ..cloudprovider.aws import aws_error_code, get_lb_name_from_hostname, get_region_from_arn
+from ..cloudprovider.aws.errors import ERR_ENDPOINT_GROUP_NOT_FOUND
+from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
+from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
+from ..reconcile import RateLimitingQueue, Result
+from .common import CloudFactory, GLOBAL_REGION, default_cloud_factory, run_workers
+
+CONTROLLER_AGENT_NAME = "endpoint-group-binding-controller"
+KIND = "EndpointGroupBinding"
+
+
+@dataclass
+class EndpointGroupBindingConfig:
+    workers: int = 1
+
+
+class EndpointGroupBindingController:
+    def __init__(
+        self,
+        client: ClusterClient,
+        informer_factory: SharedInformerFactory,
+        config: EndpointGroupBindingConfig,
+        cloud_factory: Optional[CloudFactory] = None,
+    ):
+        self._client = client
+        self._workers = config.workers
+        self._cloud = cloud_factory or default_cloud_factory
+        self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
+        self.workqueue = RateLimitingQueue(name=KIND)
+
+        self.service_lister = informer_factory.informer("Service").lister()
+        self.ingress_lister = informer_factory.informer("Ingress").lister()
+        binding_informer = informer_factory.informer(KIND)
+        self.binding_lister = binding_informer.lister()
+        binding_informer.add_event_handler(
+            on_add=self._enqueue,
+            on_update=self._update_notification,
+        )
+        self._informer_factory = informer_factory
+
+    def _update_notification(self, old, new) -> None:
+        # Changing spec.endpointGroupArn is blocked by the validating
+        # webhook; drop such events defensively too
+        # (reference ``controller.go:84-94``).
+        if old.spec.endpoint_group_arn != new.spec.endpoint_group_arn:
+            klog.error("Do not allow changing EndpointGroupArn field")
+            return
+        self._enqueue(new)
+
+    def _enqueue(self, obj) -> None:
+        self.workqueue.add_rate_limited(meta_namespace_key(obj))
+
+    # ------------------------------------------------------------------
+    # run loop (reference ``controller.go:103-141``)
+    # ------------------------------------------------------------------
+    def run(self, stop: threading.Event) -> None:
+        klog.info("Starting EndpointGroupBinding controller")
+        klog.info("Waiting for informer caches to sync")
+        if not self._informer_factory.wait_for_cache_sync(stop):
+            raise RuntimeError("failed to wait for caches to sync")
+        klog.info("Starting workers")
+        run_workers(
+            CONTROLLER_AGENT_NAME,
+            self.workqueue,
+            self._workers,
+            stop,
+            self._key_to_binding,
+            self._process_deleted_key,
+            self.reconcile,
+        )
+        klog.info("Started workers")
+        stop.wait()
+        klog.info("Shutting down workers")
+        self.workqueue.shutdown()
+
+    def _key_to_binding(self, key: str):
+        ns, name = split_meta_namespace_key(key)
+        return self.binding_lister.namespaced(ns).get(name)
+
+    @staticmethod
+    def _process_deleted_key(key: str) -> Result:
+        # Deletion is finalizer-driven; by the time the object is gone
+        # from the cache the cleanup already ran
+        # (reference ``controller.go:151-159``).
+        klog.infof("EndpointGroupBinding %s has been deleted", key)
+        return Result()
+
+    # ------------------------------------------------------------------
+    # reconcile state machine (reference ``reconcile.go:20-34``)
+    # ------------------------------------------------------------------
+    def reconcile(self, obj: EndpointGroupBinding) -> Result:
+        cloud = self._cloud(GLOBAL_REGION)
+        if obj.metadata.deletion_timestamp is not None:
+            return self._reconcile_delete(obj, cloud)
+        if not obj.metadata.finalizers:
+            return self._reconcile_create(obj)
+        return self._reconcile_update(obj, cloud)
+
+    def _reconcile_create(self, obj: EndpointGroupBinding) -> Result:
+        # obj is already the kernel's deep copy — safe to mutate
+        obj.metadata.finalizers = [FINALIZER]
+        self._client.update(KIND, obj)
+        return Result()
+
+    def _clear_finalizer(self, obj: EndpointGroupBinding) -> None:
+        obj.metadata.finalizers = []
+        self._client.update(KIND, obj)
+
+    def _reconcile_delete(self, obj: EndpointGroupBinding, cloud) -> Result:
+        if not obj.status.endpoint_ids:
+            self._clear_finalizer(obj)
+            return Result()
+
+        try:
+            endpoint_group = cloud.describe_endpoint_group(obj.spec.endpoint_group_arn)
+        except Exception as err:
+            code = aws_error_code(err)
+            if code:
+                klog.v(1).infof(
+                    "Failed to get EndpointGroup %s: %s", obj.spec.endpoint_group_arn, code
+                )
+                if code == ERR_ENDPOINT_GROUP_NOT_FOUND:
+                    # the endpoint group is gone; nothing left to detach
+                    self._clear_finalizer(obj)
+                    return Result()
+            raise
+
+        for endpoint_id in obj.status.endpoint_ids:
+            regional = self._cloud(get_region_from_arn(endpoint_id))
+            regional.remove_lb_from_endpoint_group(endpoint_group, endpoint_id)
+
+        obj.status.endpoint_ids = []
+        obj.status.observed_generation = obj.metadata.generation
+        self._client.update_status(KIND, obj)
+        return Result(requeue=True, requeue_after=1.0)
+
+    def _reconcile_update(self, obj: EndpointGroupBinding, cloud) -> Result:
+        hostnames = self._load_balancer_hostnames(obj)
+        arns: dict[str, tuple[str, str]] = {}  # lb arn -> (lb name, region)
+        for hostname in hostnames:
+            lb_name, region = get_lb_name_from_hostname(hostname)
+            regional = self._cloud(region)
+            lb = regional.get_load_balancer(lb_name)
+            arns[lb.load_balancer_arn] = (lb_name, region)
+        klog.v(4).infof("Service LoadBalancer ARNs: %r", list(arns))
+
+        new_endpoint_ids = [arn for arn in arns if arn not in obj.status.endpoint_ids]
+        removed_endpoint_ids = [
+            endpoint_id
+            for endpoint_id in obj.status.endpoint_ids
+            if endpoint_id not in arns
+        ]
+        klog.v(4).infof("New EndpointIds: %r", new_endpoint_ids)
+        klog.v(4).infof("Removed EndpointIds: %r", removed_endpoint_ids)
+        if (
+            not new_endpoint_ids
+            and not removed_endpoint_ids
+            and obj.status.observed_generation == obj.metadata.generation
+        ):
+            return Result()
+
+        endpoint_group = cloud.describe_endpoint_group(obj.spec.endpoint_group_arn)
+
+        results = list(obj.status.endpoint_ids)
+        for endpoint_id in removed_endpoint_ids:
+            regional = self._cloud(get_region_from_arn(endpoint_id))
+            regional.remove_lb_from_endpoint_group(endpoint_group, endpoint_id)
+            results = [r for r in results if r != endpoint_id]
+
+        for endpoint_id in new_endpoint_ids:
+            lb_name, region = arns[endpoint_id]
+            regional = self._cloud(region)
+            added_id, retry_after = regional.add_lb_to_endpoint_group(
+                endpoint_group,
+                lb_name,
+                obj.spec.client_ip_preservation,
+                obj.spec.weight,
+            )
+            if retry_after > 0:
+                return Result(requeue=True, requeue_after=retry_after)
+            if added_id is not None:
+                results.append(added_id)
+
+        # weight sync for every bound endpoint (reference ``reconcile.go:195-202``)
+        for endpoint_id in arns:
+            cloud.update_endpoint_weight(endpoint_group, endpoint_id, obj.spec.weight)
+
+        obj.status.endpoint_ids = results
+        obj.status.observed_generation = obj.metadata.generation
+        self._client.update_status(KIND, obj)
+        return Result()
+
+    def _load_balancer_hostnames(self, obj: EndpointGroupBinding) -> list[str]:
+        """Resolve serviceRef/ingressRef to LB hostnames via the
+        listers (reference ``reconcile.go:219-252``)."""
+        if obj.spec.service_ref is not None:
+            service = self.service_lister.namespaced(obj.metadata.namespace).get(
+                obj.spec.service_ref.name
+            )
+            ingresses = service.status.load_balancer.ingress
+            if not ingresses:
+                klog.warningf(
+                    "%s/%s does not have ingress LoadBalancer, so skip it",
+                    service.metadata.namespace,
+                    service.metadata.name,
+                )
+                return []
+            return [i.hostname for i in ingresses]
+        if obj.spec.ingress_ref is not None:
+            ingress = self.ingress_lister.namespaced(obj.metadata.namespace).get(
+                obj.spec.ingress_ref.name
+            )
+            ingresses = ingress.status.load_balancer.ingress
+            if not ingresses:
+                klog.warningf(
+                    "%s/%s does not have ingress LoadBalancer, so skip it",
+                    ingress.metadata.namespace,
+                    ingress.metadata.name,
+                )
+                return []
+            return [i.hostname for i in ingresses]
+        klog.errorf(
+            "EndpointGroupBinding %s does not have serviceRef or ingressRef",
+            obj.metadata.name,
+        )
+        return []

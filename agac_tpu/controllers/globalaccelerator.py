@@ -1,0 +1,281 @@
+"""The GlobalAccelerator controller.
+
+Capability parity with the reference's
+``pkg/controller/globalaccelerator/`` (515 LoC): watches Services and
+Ingresses, filters on the LoadBalancer/ALB predicates plus the managed
+annotation (including annotation *removal*, which must enqueue so the
+accelerator gets cleaned up), and reconciles each object into an
+accelerator → listener → endpoint-group chain via the AWS driver.
+
+Two independent rate-limited queues (service/ingress) as in the
+reference (``controller.go:64-65``); events
+``GlobalAcceleratorCreated`` / ``GlobalAcceleratorDeleted``
+(``service.go:82,117``); 30 s requeue while the LB is not Active.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import apis, klog
+from ..cloudprovider import detect_cloud_provider
+from ..cloudprovider.aws import get_lb_name_from_hostname
+from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
+from ..cluster.objects import split_meta_namespace_key, meta_namespace_key
+from ..errors import no_retry_errorf
+from ..reconcile import RateLimitingQueue, Result
+from .common import (
+    CloudFactory,
+    GLOBAL_REGION,
+    annotation_changed,
+    default_cloud_factory,
+    has_annotation,
+    run_workers,
+    unwrap_tombstone,
+    was_alb_ingress,
+    was_load_balancer_service,
+)
+
+CONTROLLER_AGENT_NAME = "global-accelerator-controller"
+
+
+@dataclass
+class GlobalAcceleratorConfig:
+    workers: int = 1
+    cluster_name: str = "default"
+
+
+class GlobalAcceleratorController:
+    def __init__(
+        self,
+        client: ClusterClient,
+        informer_factory: SharedInformerFactory,
+        config: GlobalAcceleratorConfig,
+        cloud_factory: Optional[CloudFactory] = None,
+    ):
+        self.cluster_name = config.cluster_name
+        self._workers = config.workers
+        self._cloud = cloud_factory or default_cloud_factory
+        self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
+        self.service_queue = RateLimitingQueue(name=f"{CONTROLLER_AGENT_NAME}-service")
+        self.ingress_queue = RateLimitingQueue(name=f"{CONTROLLER_AGENT_NAME}-ingress")
+
+        service_informer = informer_factory.informer("Service")
+        self.service_lister = service_informer.lister()
+        service_informer.add_event_handler(
+            on_add=self._add_service_notification,
+            on_update=self._update_service_notification,
+            on_delete=self._delete_service_notification,
+        )
+
+        ingress_informer = informer_factory.informer("Ingress")
+        self.ingress_lister = ingress_informer.lister()
+        ingress_informer.add_event_handler(
+            on_add=self._add_ingress_notification,
+            on_update=self._update_ingress_notification,
+            on_delete=self._delete_ingress_notification,
+        )
+        self._informer_factory = informer_factory
+
+    # ------------------------------------------------------------------
+    # event handlers (reference ``controller.go:91-173``)
+    # ------------------------------------------------------------------
+    def _add_service_notification(self, svc) -> None:
+        if was_load_balancer_service(svc) and has_annotation(
+            svc, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+        ):
+            klog.v(4).infof(
+                "Service %s/%s is created", svc.metadata.namespace, svc.metadata.name
+            )
+            self._enqueue(self.service_queue, svc)
+
+    def _update_service_notification(self, old, new) -> None:
+        if old == new:
+            return
+        if was_load_balancer_service(new):
+            if has_annotation(
+                new, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+            ) or annotation_changed(
+                old, new, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+            ):
+                klog.v(4).infof(
+                    "Service %s/%s is updated",
+                    new.metadata.namespace,
+                    new.metadata.name,
+                )
+                self._enqueue(self.service_queue, new)
+
+    def _delete_service_notification(self, obj) -> None:
+        svc = unwrap_tombstone(obj)
+        if svc is None:
+            return
+        if was_load_balancer_service(svc):
+            klog.v(4).infof(
+                "Deleting Service %s/%s", svc.metadata.namespace, svc.metadata.name
+            )
+            self._enqueue(self.service_queue, svc)
+
+    def _add_ingress_notification(self, ingress) -> None:
+        if was_alb_ingress(ingress) and has_annotation(
+            ingress, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+        ):
+            klog.v(4).infof(
+                "Ingress %s/%s is created",
+                ingress.metadata.namespace,
+                ingress.metadata.name,
+            )
+            self._enqueue(self.ingress_queue, ingress)
+
+    def _update_ingress_notification(self, old, new) -> None:
+        if old == new:
+            return
+        if was_alb_ingress(new):
+            if has_annotation(
+                new, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+            ) or annotation_changed(
+                old, new, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+            ):
+                klog.v(4).infof(
+                    "Ingress %s/%s is updated",
+                    new.metadata.namespace,
+                    new.metadata.name,
+                )
+                self._enqueue(self.ingress_queue, new)
+
+    def _delete_ingress_notification(self, obj) -> None:
+        ingress = unwrap_tombstone(obj)
+        if ingress is None:
+            return
+        klog.v(4).infof(
+            "Deleting Ingress %s/%s",
+            ingress.metadata.namespace,
+            ingress.metadata.name,
+        )
+        self._enqueue(self.ingress_queue, ingress)
+
+    @staticmethod
+    def _enqueue(queue: RateLimitingQueue, obj) -> None:
+        queue.add_rate_limited(meta_namespace_key(obj))
+
+    # ------------------------------------------------------------------
+    # run loop (reference ``controller.go:195-229``)
+    # ------------------------------------------------------------------
+    def run(self, stop: threading.Event) -> None:
+        klog.info("Starting GlobalAccelerator controller")
+        klog.info("Waiting for informer caches to sync")
+        if not self._informer_factory.wait_for_cache_sync(stop):
+            raise RuntimeError("failed to wait for caches to sync")
+        klog.info("Starting workers")
+        run_workers(
+            f"{CONTROLLER_AGENT_NAME}-service",
+            self.service_queue,
+            self._workers,
+            stop,
+            self._key_to_service,
+            self.process_service_delete,
+            self.process_service_create_or_update,
+        )
+        run_workers(
+            f"{CONTROLLER_AGENT_NAME}-ingress",
+            self.ingress_queue,
+            self._workers,
+            stop,
+            self._key_to_ingress,
+            self.process_ingress_delete,
+            self.process_ingress_create_or_update,
+        )
+        klog.info("Started workers")
+        stop.wait()
+        klog.info("Shutting down workers")
+        self.service_queue.shutdown()
+        self.ingress_queue.shutdown()
+
+    def _key_to_service(self, key: str):
+        ns, name = split_meta_namespace_key(key)
+        return self.service_lister.namespaced(ns).get(name)
+
+    def _key_to_ingress(self, key: str):
+        ns, name = split_meta_namespace_key(key)
+        return self.ingress_lister.namespaced(ns).get(name)
+
+    # ------------------------------------------------------------------
+    # process funcs (reference ``service.go`` / ``ingress.go``)
+    # ------------------------------------------------------------------
+    def process_service_delete(self, key: str) -> Result:
+        return self._process_delete(key, "service")
+
+    def process_ingress_delete(self, key: str) -> Result:
+        return self._process_delete(key, "ingress")
+
+    def _process_delete(self, key: str, resource: str) -> Result:
+        klog.infof("%s has been deleted", key)
+        ns, name = split_meta_namespace_key(key)
+        cloud = self._cloud(GLOBAL_REGION)
+        for accelerator in cloud.list_global_accelerator_by_resource(
+            self.cluster_name, resource, ns, name
+        ):
+            cloud.cleanup_global_accelerator(accelerator.accelerator_arn)
+        return Result()
+
+    def process_service_create_or_update(self, svc) -> Result:
+        if getattr(svc, "KIND", None) != "Service":
+            raise no_retry_errorf("object is not Service, it is %s", type(svc).__name__)
+        return self._process_create_or_update(svc, "service", "Service")
+
+    def process_ingress_create_or_update(self, ingress) -> Result:
+        if getattr(ingress, "KIND", None) != "Ingress":
+            raise no_retry_errorf(
+                "object is not Ingress, it is %s", type(ingress).__name__
+            )
+        return self._process_create_or_update(ingress, "ingress", "Ingress")
+
+    def _process_create_or_update(self, obj, resource: str, kind: str) -> Result:
+        ns, name = obj.metadata.namespace, obj.metadata.name
+        if not obj.status.load_balancer.ingress:
+            klog.warningf("%s/%s does not have ingress LoadBalancer, so skip it", ns, name)
+            return Result()
+
+        if apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION not in obj.metadata.annotations:
+            cloud = self._cloud(GLOBAL_REGION)
+            for accelerator in cloud.list_global_accelerator_by_resource(
+                self.cluster_name, resource, ns, name
+            ):
+                cloud.cleanup_global_accelerator(accelerator.accelerator_arn)
+            klog.infof("Delete Global Accelerator for %s %s/%s", kind, ns, name)
+            self.recorder.event(
+                obj, "Normal", "GlobalAcceleratorDeleted", "Global Accelerators are deleted"
+            )
+            return Result()
+
+        for lb_ingress in obj.status.load_balancer.ingress:
+            try:
+                provider = detect_cloud_provider(lb_ingress.hostname)
+            except ValueError as err:
+                klog.error(err)
+                continue
+            if provider != "aws":
+                klog.warningf("Not implemented for %s", provider)
+                continue
+            lb_name, region = get_lb_name_from_hostname(lb_ingress.hostname)
+            cloud = self._cloud(region)
+            if resource == "service":
+                arn, created, retry_after = cloud.ensure_global_accelerator_for_service(
+                    obj, lb_ingress, self.cluster_name, lb_name, region
+                )
+            else:
+                arn, created, retry_after = cloud.ensure_global_accelerator_for_ingress(
+                    obj, lb_ingress, self.cluster_name, lb_name, region
+                )
+            if retry_after > 0:
+                return Result(requeue=True, requeue_after=retry_after)
+            if created:
+                self.recorder.eventf(
+                    obj,
+                    "Normal",
+                    "GlobalAcceleratorCreated",
+                    "Global Accelerator is created: %s",
+                    arn,
+                )
+        return Result()

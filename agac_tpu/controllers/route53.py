@@ -1,0 +1,244 @@
+"""The Route53 controller.
+
+Capability parity with the reference's ``pkg/controller/route53/``
+(467 LoC): watches Services and Ingresses carrying the
+``route53-hostname`` annotation (comma-separated hostnames, wildcards
+allowed), ensures a TXT-ownership record plus an A-alias record to the
+managed accelerator per hostname, and cleans up by scanning all hosted
+zones on delete or annotation removal.
+
+Cross-controller coupling is via AWS state only: the accelerator is
+discovered through its tags and the reconcile requeues every minute
+until the GlobalAccelerator controller has converged
+(reference ``pkg/cloudprovider/aws/route53.go:63-77``).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import apis, klog
+from ..cloudprovider import detect_cloud_provider
+from ..cloudprovider.aws import get_lb_name_from_hostname
+from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
+from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
+from ..errors import no_retry_errorf
+from ..reconcile import RateLimitingQueue, Result
+from .common import (
+    CloudFactory,
+    GLOBAL_REGION,
+    annotation_changed,
+    default_cloud_factory,
+    has_annotation,
+    run_workers,
+    unwrap_tombstone,
+    was_load_balancer_service,
+)
+
+CONTROLLER_AGENT_NAME = "route53-controller"
+
+
+@dataclass
+class Route53Config:
+    workers: int = 1
+    cluster_name: str = "default"
+
+
+class Route53Controller:
+    def __init__(
+        self,
+        client: ClusterClient,
+        informer_factory: SharedInformerFactory,
+        config: Route53Config,
+        cloud_factory: Optional[CloudFactory] = None,
+    ):
+        self.cluster_name = config.cluster_name
+        self._workers = config.workers
+        self._cloud = cloud_factory or default_cloud_factory
+        self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
+        self.service_queue = RateLimitingQueue(name=f"{CONTROLLER_AGENT_NAME}-service")
+        self.ingress_queue = RateLimitingQueue(name=f"{CONTROLLER_AGENT_NAME}-ingress")
+
+        service_informer = informer_factory.informer("Service")
+        self.service_lister = service_informer.lister()
+        service_informer.add_event_handler(
+            on_add=self._add_service_notification,
+            on_update=self._update_service_notification,
+            on_delete=self._delete_service_notification,
+        )
+        ingress_informer = informer_factory.informer("Ingress")
+        self.ingress_lister = ingress_informer.lister()
+        ingress_informer.add_event_handler(
+            on_add=self._add_ingress_notification,
+            on_update=self._update_ingress_notification,
+            on_delete=self._delete_ingress_notification,
+        )
+        self._informer_factory = informer_factory
+
+    # ------------------------------------------------------------------
+    # event handlers (reference ``route53/controller.go:89-170``)
+    # ------------------------------------------------------------------
+    def _add_service_notification(self, svc) -> None:
+        if was_load_balancer_service(svc) and has_annotation(
+            svc, apis.ROUTE53_HOSTNAME_ANNOTATION
+        ):
+            self._enqueue(self.service_queue, svc)
+
+    def _update_service_notification(self, old, new) -> None:
+        if old == new:
+            return
+        if was_load_balancer_service(new):
+            if has_annotation(new, apis.ROUTE53_HOSTNAME_ANNOTATION) or annotation_changed(
+                old, new, apis.ROUTE53_HOSTNAME_ANNOTATION
+            ):
+                self._enqueue(self.service_queue, new)
+
+    def _delete_service_notification(self, obj) -> None:
+        svc = unwrap_tombstone(obj)
+        if svc is None:
+            return
+        if was_load_balancer_service(svc):
+            self._enqueue(self.service_queue, svc)
+
+    def _add_ingress_notification(self, ingress) -> None:
+        # the reference gates ingress adds on the hostname annotation
+        # only, not the ALB predicate (``route53/controller.go:131-136``)
+        if has_annotation(ingress, apis.ROUTE53_HOSTNAME_ANNOTATION):
+            self._enqueue(self.ingress_queue, ingress)
+
+    def _update_ingress_notification(self, old, new) -> None:
+        if old == new:
+            return
+        if has_annotation(new, apis.ROUTE53_HOSTNAME_ANNOTATION) or annotation_changed(
+            old, new, apis.ROUTE53_HOSTNAME_ANNOTATION
+        ):
+            self._enqueue(self.ingress_queue, new)
+
+    def _delete_ingress_notification(self, obj) -> None:
+        ingress = unwrap_tombstone(obj)
+        if ingress is None:
+            return
+        self._enqueue(self.ingress_queue, ingress)
+
+    @staticmethod
+    def _enqueue(queue: RateLimitingQueue, obj) -> None:
+        queue.add_rate_limited(meta_namespace_key(obj))
+
+    # ------------------------------------------------------------------
+    # run loop
+    # ------------------------------------------------------------------
+    def run(self, stop: threading.Event) -> None:
+        klog.info("Starting Route53 controller")
+        klog.info("Waiting for informer caches to sync")
+        if not self._informer_factory.wait_for_cache_sync(stop):
+            raise RuntimeError("failed to wait for caches to sync")
+        klog.info("Starting workers")
+        run_workers(
+            f"{CONTROLLER_AGENT_NAME}-service",
+            self.service_queue,
+            self._workers,
+            stop,
+            self._key_to_service,
+            self.process_service_delete,
+            self.process_service_create_or_update,
+        )
+        run_workers(
+            f"{CONTROLLER_AGENT_NAME}-ingress",
+            self.ingress_queue,
+            self._workers,
+            stop,
+            self._key_to_ingress,
+            self.process_ingress_delete,
+            self.process_ingress_create_or_update,
+        )
+        klog.info("Started workers")
+        stop.wait()
+        klog.info("Shutting down workers")
+        self.service_queue.shutdown()
+        self.ingress_queue.shutdown()
+
+    def _key_to_service(self, key: str):
+        ns, name = split_meta_namespace_key(key)
+        return self.service_lister.namespaced(ns).get(name)
+
+    def _key_to_ingress(self, key: str):
+        ns, name = split_meta_namespace_key(key)
+        return self.ingress_lister.namespaced(ns).get(name)
+
+    # ------------------------------------------------------------------
+    # process funcs (reference ``route53/service.go`` / ``ingress.go``)
+    # ------------------------------------------------------------------
+    def process_service_delete(self, key: str) -> Result:
+        return self._process_delete(key, "service")
+
+    def process_ingress_delete(self, key: str) -> Result:
+        return self._process_delete(key, "ingress")
+
+    def _process_delete(self, key: str, resource: str) -> Result:
+        klog.infof("%s has been deleted", key)
+        ns, name = split_meta_namespace_key(key)
+        cloud = self._cloud(GLOBAL_REGION)
+        cloud.cleanup_record_set(self.cluster_name, resource, ns, name)
+        return Result()
+
+    def process_service_create_or_update(self, svc) -> Result:
+        if getattr(svc, "KIND", None) != "Service":
+            raise no_retry_errorf("object is not Service, it is %s", type(svc).__name__)
+        return self._process_create_or_update(
+            svc, "service", svc.status.load_balancer.ingress, "Service"
+        )
+
+    def process_ingress_create_or_update(self, ingress) -> Result:
+        if getattr(ingress, "KIND", None) != "Ingress":
+            raise no_retry_errorf(
+                "object is not Ingress, it is %s", type(ingress).__name__
+            )
+        return self._process_create_or_update(
+            ingress, "ingress", ingress.status.load_balancer.ingress, "Ingress"
+        )
+
+    def _process_create_or_update(self, obj, resource: str, lb_ingresses, kind: str) -> Result:
+        ns, name = obj.metadata.namespace, obj.metadata.name
+        hostname_annotation = obj.metadata.annotations.get(apis.ROUTE53_HOSTNAME_ANNOTATION)
+        if hostname_annotation is None:
+            cloud = self._cloud(GLOBAL_REGION)
+            cloud.cleanup_record_set(self.cluster_name, resource, ns, name)
+            klog.infof("Delete route53 records for %s %s/%s", kind, ns, name)
+            self.recorder.event(
+                obj, "Normal", "Route53RecordDeleted", "Route53 record sets are deleted"
+            )
+            return Result()
+
+        hostnames = hostname_annotation.split(",")
+        for lb_ingress in lb_ingresses:
+            try:
+                provider = detect_cloud_provider(lb_ingress.hostname)
+            except ValueError as err:
+                klog.error(err)
+                continue
+            if provider != "aws":
+                klog.warningf("Not implemented for %s", provider)
+                continue
+            _, region = get_lb_name_from_hostname(lb_ingress.hostname)
+            cloud = self._cloud(region)
+            if resource == "service":
+                created, retry_after = cloud.ensure_route53_for_service(
+                    obj, lb_ingress, hostnames, self.cluster_name
+                )
+            else:
+                created, retry_after = cloud.ensure_route53_for_ingress(
+                    obj, lb_ingress, hostnames, self.cluster_name
+                )
+            if retry_after > 0:
+                return Result(requeue=True, requeue_after=retry_after)
+            if created:
+                self.recorder.eventf(
+                    obj,
+                    "Normal",
+                    "Route53RecordCreated",
+                    "Route53 record set is created: %s",
+                    hostnames,
+                )
+        return Result()

@@ -1,0 +1,336 @@
+"""Full-loop controller tests against the fake apiserver + fake AWS —
+the analog of the reference's ``local_e2e`` suite
+(``local_e2e/e2e_test.go``): create annotated objects, poll until the
+cloud state converges, mutate, poll again, delete, poll until clean.
+This exercises every layer: informers → predicates → queues →
+reconcile kernel → controllers → drivers → (fake) AWS.
+"""
+
+import threading
+import time
+
+import pytest
+
+from agac_tpu import apis
+from agac_tpu.apis.endpointgroupbinding import (
+    FINALIZER,
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cluster import FakeCluster, ObjectMeta
+from agac_tpu.errors import NotFoundError
+from agac_tpu.manager import ControllerConfig, Manager
+
+from .fixtures import (
+    ALB_HOSTNAME,
+    ALB_NAME,
+    NLB_HOSTNAME,
+    NLB_NAME,
+    NLB_REGION,
+    make_alb_ingress,
+    make_lb_service,
+)
+
+POLL_TIMEOUT = 10.0
+
+
+def wait_until(pred, timeout=POLL_TIMEOUT, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class Harness:
+    def __init__(self):
+        self.cluster = FakeCluster()
+        self.aws = FakeAWSBackend()
+        self.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        self.aws.add_load_balancer(ALB_NAME, NLB_REGION, ALB_HOSTNAME, lb_type="application")
+        self.stop = threading.Event()
+        self.manager = Manager(resync_period=0.3)
+        self.manager.run(
+            self.cluster,
+            ControllerConfig(),
+            self.stop,
+            cloud_factory=lambda region: AWSDriver(
+                self.aws,
+                self.aws,
+                self.aws,
+                poll_interval=0.01,
+                poll_timeout=2.0,
+                lb_not_active_retry=0.05,
+                accelerator_missing_retry=0.05,
+            ),
+            block=False,
+        )
+
+    def shutdown(self):
+        self.stop.set()
+
+
+@pytest.fixture
+def harness():
+    h = Harness()
+    yield h
+    h.shutdown()
+
+
+def accelerators(h):
+    return h.aws.all_accelerator_arns()
+
+
+class TestGlobalAcceleratorServicePath:
+    def test_create_converge_cleanup(self, harness):
+        svc = make_lb_service()
+        harness.cluster.create("Service", svc)
+
+        # accelerator chain converges
+        assert wait_until(lambda: len(accelerators(harness)) == 1)
+        arn = accelerators(harness)[0]
+        tags = {t.key: t.value for t in harness.aws.list_tags_for_resource(arn)}
+        assert tags["aws-global-accelerator-owner"] == "service/default/web"
+        # created event emitted
+        assert wait_until(
+            lambda: any(
+                e.reason == "GlobalAcceleratorCreated"
+                for e in harness.cluster.list("Event")[0]
+            )
+        )
+
+        # removing the managed annotation cleans up the accelerator
+        obj = harness.cluster.get("Service", "default", "web")
+        del obj.metadata.annotations[apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION]
+        harness.cluster.update("Service", obj)
+        assert wait_until(lambda: accelerators(harness) == [])
+        assert wait_until(
+            lambda: any(
+                e.reason == "GlobalAcceleratorDeleted"
+                for e in harness.cluster.list("Event")[0]
+            )
+        )
+
+    def test_service_delete_cleans_up(self, harness):
+        harness.cluster.create("Service", make_lb_service())
+        assert wait_until(lambda: len(accelerators(harness)) == 1)
+        harness.cluster.delete("Service", "default", "web")
+        assert wait_until(lambda: accelerators(harness) == [])
+
+    def test_port_change_updates_listener(self, harness):
+        harness.cluster.create("Service", make_lb_service(ports=((80, "TCP"),)))
+        assert wait_until(lambda: len(accelerators(harness)) == 1)
+        arn = accelerators(harness)[0]
+
+        obj = harness.cluster.get("Service", "default", "web")
+        from agac_tpu.cluster.objects import ServicePort
+
+        obj.spec.ports.append(ServicePort(name="https", port=443, protocol="TCP"))
+        harness.cluster.update("Service", obj)
+
+        def listener_has_both_ports():
+            listeners, _ = harness.aws.list_listeners(arn, 100, None)
+            if not listeners:
+                return False
+            return sorted(p.from_port for p in listeners[0].port_ranges) == [80, 443]
+
+        assert wait_until(listener_has_both_ports)
+
+    def test_unmanaged_service_ignored(self, harness):
+        harness.cluster.create("Service", make_lb_service(name="plain", managed=False))
+        time.sleep(0.5)
+        assert accelerators(harness) == []
+
+    def test_service_without_lb_status_skipped(self, harness):
+        harness.cluster.create("Service", make_lb_service(name="pending", hostname=None))
+        time.sleep(0.5)
+        assert accelerators(harness) == []
+
+
+class TestGlobalAcceleratorIngressPath:
+    def test_ingress_create_and_cleanup(self, harness):
+        ing = make_alb_ingress()
+        harness.cluster.create("Ingress", ing)
+        assert wait_until(lambda: len(accelerators(harness)) == 1)
+        arn = accelerators(harness)[0]
+        tags = {t.key: t.value for t in harness.aws.list_tags_for_resource(arn)}
+        assert tags["aws-global-accelerator-owner"] == "ingress/default/webapp"
+
+        harness.cluster.delete("Ingress", "default", "webapp")
+        assert wait_until(lambda: accelerators(harness) == [])
+
+
+class TestRoute53Path:
+    def test_records_converge_after_accelerator(self, harness):
+        zone = harness.aws.add_hosted_zone("example.com")
+        svc = make_lb_service(
+            annotations={apis.ROUTE53_HOSTNAME_ANNOTATION: "app.example.com"}
+        )
+        harness.cluster.create("Service", svc)
+
+        # both controllers converge: accelerator first, then records
+        def records_exist():
+            names = {(r.name, r.type) for r in harness.aws.records_in_zone(zone.id)}
+            return ("app.example.com.", "A") in names and (
+                "app.example.com.",
+                "TXT",
+            ) in names
+
+        assert wait_until(records_exist)
+        # A record aliases the accelerator
+        arn = accelerators(harness)[0]
+        accelerator = harness.aws.describe_accelerator(arn)
+        a_record = [
+            r
+            for r in harness.aws.records_in_zone(zone.id)
+            if r.type == "A" and r.name == "app.example.com."
+        ][0]
+        assert a_record.alias_target.dns_name == accelerator.dns_name + "."
+
+    def test_multi_hostname_and_cleanup_on_annotation_removal(self, harness):
+        zone = harness.aws.add_hosted_zone("example.com")
+        svc = make_lb_service(
+            annotations={
+                apis.ROUTE53_HOSTNAME_ANNOTATION: "a.example.com,b.example.com"
+            }
+        )
+        harness.cluster.create("Service", svc)
+        assert wait_until(
+            lambda: {
+                (r.name, r.type) for r in harness.aws.records_in_zone(zone.id)
+            }
+            >= {("a.example.com.", "A"), ("b.example.com.", "A")}
+        )
+
+        obj = harness.cluster.get("Service", "default", "web")
+        del obj.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION]
+        harness.cluster.update("Service", obj)
+        assert wait_until(lambda: harness.aws.records_in_zone(zone.id) == [])
+
+    def test_service_delete_cleans_records(self, harness):
+        zone = harness.aws.add_hosted_zone("example.com")
+        svc = make_lb_service(
+            annotations={apis.ROUTE53_HOSTNAME_ANNOTATION: "app.example.com"}
+        )
+        harness.cluster.create("Service", svc)
+        assert wait_until(lambda: len(harness.aws.records_in_zone(zone.id)) == 2)
+        harness.cluster.delete("Service", "default", "web")
+        assert wait_until(lambda: harness.aws.records_in_zone(zone.id) == [])
+
+
+class TestEndpointGroupBindingPath:
+    def setup_endpoint_group(self, harness):
+        """Create a GA chain out-of-band whose endpoint group the CRD
+        will bind a second LB into."""
+        driver = AWSDriver(harness.aws, harness.aws, harness.aws)
+        svc = make_lb_service()
+        arn, _, _ = driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "other", NLB_NAME, NLB_REGION
+        )
+        listener = driver.get_listener(arn)
+        return driver.get_endpoint_group(listener.listener_arn)
+
+    def make_binding(self, endpoint_group, weight=None, service="bound"):
+        return EndpointGroupBinding(
+            metadata=ObjectMeta(name="binding", namespace="default"),
+            spec=EndpointGroupBindingSpec(
+                endpoint_group_arn=endpoint_group.endpoint_group_arn,
+                weight=weight,
+                service_ref=ServiceReference(name=service),
+            ),
+        )
+
+    def test_full_lifecycle(self, harness):
+        endpoint_group = self.setup_endpoint_group(harness)
+        harness.aws.add_load_balancer(
+            "bound", NLB_REGION, "bound-0123456789abcdef.elb.us-west-2.amazonaws.com"
+        )
+        harness.cluster.create(
+            "Service",
+            make_lb_service(
+                name="bound",
+                hostname="bound-0123456789abcdef.elb.us-west-2.amazonaws.com",
+            ),
+        )
+        binding = self.make_binding(endpoint_group, weight=100)
+        harness.cluster.create("EndpointGroupBinding", binding)
+
+        # finalizer installed, endpoint bound, status tracks it
+        def bound():
+            try:
+                obj = harness.cluster.get("EndpointGroupBinding", "default", "binding")
+            except NotFoundError:
+                return False
+            return obj.metadata.finalizers == [FINALIZER] and len(obj.status.endpoint_ids) == 1
+
+        assert wait_until(bound)
+        obj = harness.cluster.get("EndpointGroupBinding", "default", "binding")
+        described = harness.aws.describe_endpoint_group(endpoint_group.endpoint_group_arn)
+        bound_ids = [d.endpoint_id for d in described.endpoint_descriptions]
+        assert obj.status.endpoint_ids[0] in bound_ids
+        weights = {d.endpoint_id: d.weight for d in described.endpoint_descriptions}
+        assert weights[obj.status.endpoint_ids[0]] == 100
+        assert obj.status.observed_generation == obj.metadata.generation
+
+        # weight change propagates
+        obj.spec.weight = 7
+        harness.cluster.update("EndpointGroupBinding", obj)
+
+        def weight_updated():
+            described = harness.aws.describe_endpoint_group(
+                endpoint_group.endpoint_group_arn
+            )
+            return any(d.weight == 7 for d in described.endpoint_descriptions)
+
+        assert wait_until(weight_updated)
+
+        # delete: endpoints removed, finalizer cleared, object gone
+        bound_id = obj.status.endpoint_ids[0]
+        harness.cluster.delete("EndpointGroupBinding", "default", "binding")
+
+        def gone():
+            try:
+                harness.cluster.get("EndpointGroupBinding", "default", "binding")
+                return False
+            except NotFoundError:
+                return True
+
+        assert wait_until(gone)
+        described = harness.aws.describe_endpoint_group(endpoint_group.endpoint_group_arn)
+        assert bound_id not in [d.endpoint_id for d in described.endpoint_descriptions]
+
+    def test_delete_with_vanished_endpoint_group(self, harness):
+        endpoint_group = self.setup_endpoint_group(harness)
+        harness.aws.add_load_balancer(
+            "bound", NLB_REGION, "bound-0123456789abcdef.elb.us-west-2.amazonaws.com"
+        )
+        harness.cluster.create(
+            "Service",
+            make_lb_service(
+                name="bound",
+                hostname="bound-0123456789abcdef.elb.us-west-2.amazonaws.com",
+            ),
+        )
+        harness.cluster.create(
+            "EndpointGroupBinding", self.make_binding(endpoint_group, weight=None)
+        )
+        assert wait_until(
+            lambda: harness.cluster.get(
+                "EndpointGroupBinding", "default", "binding"
+            ).status.endpoint_ids
+        )
+        # the endpoint group disappears out from under the binding
+        harness.aws.delete_endpoint_group(endpoint_group.endpoint_group_arn)
+        harness.cluster.delete("EndpointGroupBinding", "default", "binding")
+
+        def gone():
+            try:
+                harness.cluster.get("EndpointGroupBinding", "default", "binding")
+                return False
+            except NotFoundError:
+                return True
+
+        assert wait_until(gone)
