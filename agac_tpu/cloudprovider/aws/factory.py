@@ -1,0 +1,40 @@
+"""Cloud factory used by the CLI process.
+
+The production analog of the reference's inline ``NewAWS(region)``
+calls: one driver per region, with GA/Route53 pinned to the global
+endpoint region (us-west-2, reference ``aws.go:26-32``).
+
+``AGAC_CLOUD=fake`` switches the whole process onto one shared
+in-memory backend — the no-credentials demo/e2e mode (the reference
+has no equivalent; its e2e needs real AWS).  The default mode builds
+the real SigV4 HTTP backend.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .driver import AWSDriver
+from .fake_backend import FakeAWSBackend
+
+_fake_backend: FakeAWSBackend | None = None
+_lock = threading.Lock()
+
+
+def shared_fake_backend() -> FakeAWSBackend:
+    global _fake_backend
+    with _lock:
+        if _fake_backend is None:
+            _fake_backend = FakeAWSBackend()
+        return _fake_backend
+
+
+def real_cloud_factory(region: str) -> AWSDriver:
+    if os.environ.get("AGAC_CLOUD") == "fake":
+        backend = shared_fake_backend()
+        return AWSDriver(backend, backend, backend)
+    from .real_backend import RealAWSClients
+
+    clients = RealAWSClients.from_environment(region)
+    return AWSDriver(clients.ga, clients.elbv2, clients.route53)

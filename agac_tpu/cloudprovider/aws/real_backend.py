@@ -1,0 +1,583 @@
+"""Real AWS service clients over stdlib HTTP with SigV4 signing.
+
+The production counterpart of ``FakeAWSBackend``, implementing the
+same three API interfaces the drivers consume — the analog of the
+aws-sdk-go-v2 clients the reference constructs
+(``pkg/cloudprovider/aws/aws.go:12-38``).  Three wire protocols:
+
+- **Global Accelerator**: AWS JSON 1.1 (``X-Amz-Target:
+  GlobalAccelerator_V20180706.<Op>``), global endpoint in us-west-2 —
+  the same pinning as the reference (``aws.go:26-28``);
+- **ELBv2**: Query protocol (form-encoded ``Action=...``), XML
+  responses, regional endpoints;
+- **Route53**: REST XML on the global endpoint (signed as us-east-1).
+
+Transport is injectable for tests; error bodies are mapped onto
+``AWSAPIError`` with the service error code so the drivers' code-based
+branching (``EndpointGroupNotFoundException`` etc.) works identically
+against fake and real backends.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
+from .errors import (
+    AWSAPIError,
+    ERR_ENDPOINT_GROUP_NOT_FOUND,
+    ERR_LISTENER_NOT_FOUND,
+    EndpointGroupNotFoundException,
+    ListenerNotFoundException,
+)
+from .sigv4 import Credentials, CredentialProvider, sign_request
+from .types import (
+    Accelerator,
+    AliasTarget,
+    Change,
+    EndpointConfiguration,
+    EndpointDescription,
+    EndpointGroup,
+    HostedZone,
+    Listener,
+    LoadBalancer,
+    PortRange,
+    ResourceRecord,
+    ResourceRecordSet,
+    Tag,
+)
+
+GA_ENDPOINT_REGION = "us-west-2"  # Global Accelerator is a global service
+GA_TARGET_PREFIX = "GlobalAccelerator_V20180706"
+ELBV2_API_VERSION = "2015-12-01"
+ROUTE53_API_VERSION = "2013-04-01"
+
+Transport = Callable[[str, str, dict, Optional[bytes], float], tuple[int, bytes]]
+
+
+def _default_transport(method, url, headers, body, timeout) -> tuple[int, bytes]:
+    request = urllib.request.Request(url, data=body, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(request, timeout=timeout) as response:
+            return response.status, response.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+class _SignedClient:
+    def __init__(
+        self,
+        service: str,
+        region: str,
+        endpoint: str,
+        credentials=None,
+        transport: Optional[Transport] = None,
+        timeout: float = 30.0,
+    ):
+        self.service = service
+        self.region = region
+        self.endpoint = endpoint.rstrip("/")
+        if credentials is None:
+            self._provider = CredentialProvider()
+        elif isinstance(credentials, Credentials):
+            self._provider = CredentialProvider(static=credentials)
+        else:  # already a provider
+            self._provider = credentials
+        self._transport = transport or _default_transport
+        self._timeout = timeout
+
+    def request(
+        self, method: str, path: str, headers: dict[str, str], body: bytes
+    ) -> tuple[int, bytes]:
+        url = f"{self.endpoint}{path}"
+        # per-request credential fetch: the provider refreshes expiring
+        # session credentials (IRSA) transparently
+        signed = sign_request(
+            method, url, headers, body, self.service, self.region, self._provider.get()
+        )
+        return self._transport(method, url, signed, body or None, self._timeout)
+
+
+# ---------------------------------------------------------------------------
+# Global Accelerator (AWS JSON 1.1)
+# ---------------------------------------------------------------------------
+
+
+def _ga_error(status: int, body: bytes) -> AWSAPIError:
+    code, message = "UnknownError", ""
+    try:
+        payload = json.loads(body)
+        raw = payload.get("__type") or payload.get("code") or ""
+        code = raw.split("#")[-1] or code
+        message = payload.get("message") or payload.get("Message") or ""
+    except Exception:
+        message = body[:200].decode(errors="replace")
+    if code == ERR_LISTENER_NOT_FOUND:
+        return ListenerNotFoundException(message)
+    if code == ERR_ENDPOINT_GROUP_NOT_FOUND:
+        return EndpointGroupNotFoundException(message)
+    return AWSAPIError(code, message or f"HTTP {status}")
+
+
+def _accelerator_from_json(data: dict) -> Accelerator:
+    return Accelerator(
+        accelerator_arn=data.get("AcceleratorArn", ""),
+        name=data.get("Name", ""),
+        dns_name=data.get("DnsName", ""),
+        enabled=bool(data.get("Enabled", False)),
+        status=data.get("Status", ""),
+        ip_address_type=data.get("IpAddressType", "IPV4"),
+    )
+
+
+def _listener_from_json(data: dict) -> Listener:
+    return Listener(
+        listener_arn=data.get("ListenerArn", ""),
+        protocol=data.get("Protocol", "TCP"),
+        port_ranges=[
+            PortRange(p.get("FromPort", 0), p.get("ToPort", 0))
+            for p in data.get("PortRanges", [])
+        ],
+        client_affinity=data.get("ClientAffinity", "NONE"),
+    )
+
+
+def _endpoint_group_from_json(data: dict) -> EndpointGroup:
+    return EndpointGroup(
+        endpoint_group_arn=data.get("EndpointGroupArn", ""),
+        endpoint_group_region=data.get("EndpointGroupRegion", ""),
+        endpoint_descriptions=[
+            EndpointDescription(
+                endpoint_id=d.get("EndpointId", ""),
+                weight=d.get("Weight"),
+                client_ip_preservation_enabled=bool(
+                    d.get("ClientIPPreservationEnabled", False)
+                ),
+            )
+            for d in data.get("EndpointDescriptions", [])
+        ],
+    )
+
+
+def _endpoint_configurations_json(configs: list[EndpointConfiguration]) -> list[dict]:
+    out = []
+    for c in configs:
+        entry: dict = {
+            "EndpointId": c.endpoint_id,
+            "ClientIPPreservationEnabled": c.client_ip_preservation_enabled,
+        }
+        if c.weight is not None:
+            entry["Weight"] = c.weight
+        out.append(entry)
+    return out
+
+
+class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
+    def __init__(self, credentials=None, transport=None, endpoint=None):
+        self._client = _SignedClient(
+            "globalaccelerator",
+            GA_ENDPOINT_REGION,
+            endpoint or f"https://globalaccelerator.{GA_ENDPOINT_REGION}.amazonaws.com",
+            credentials,
+            transport,
+        )
+
+    def _call(self, operation: str, payload: dict) -> dict:
+        body = json.dumps(payload).encode()
+        status, response = self._client.request(
+            "POST",
+            "/",
+            {
+                "Content-Type": "application/x-amz-json-1.1",
+                "X-Amz-Target": f"{GA_TARGET_PREFIX}.{operation}",
+            },
+            body,
+        )
+        if status >= 300:
+            raise _ga_error(status, response)
+        return json.loads(response) if response else {}
+
+    # accelerators
+    def list_accelerators(self, max_results, next_token):
+        payload: dict = {"MaxResults": max_results}
+        if next_token:
+            payload["NextToken"] = next_token
+        data = self._call("ListAccelerators", payload)
+        return (
+            [_accelerator_from_json(a) for a in data.get("Accelerators", [])],
+            data.get("NextToken"),
+        )
+
+    def describe_accelerator(self, arn):
+        data = self._call("DescribeAccelerator", {"AcceleratorArn": arn})
+        return _accelerator_from_json(data.get("Accelerator", {}))
+
+    def create_accelerator(self, name, ip_address_type, enabled, tags):
+        data = self._call(
+            "CreateAccelerator",
+            {
+                "Name": name,
+                "IpAddressType": ip_address_type,
+                "Enabled": enabled,
+                "Tags": [{"Key": t.key, "Value": t.value} for t in tags],
+            },
+        )
+        return _accelerator_from_json(data.get("Accelerator", {}))
+
+    def update_accelerator(self, arn, name=None, enabled=None):
+        payload: dict = {"AcceleratorArn": arn}
+        if name is not None:
+            payload["Name"] = name
+        if enabled is not None:
+            payload["Enabled"] = enabled
+        data = self._call("UpdateAccelerator", payload)
+        return _accelerator_from_json(data.get("Accelerator", {}))
+
+    def delete_accelerator(self, arn):
+        self._call("DeleteAccelerator", {"AcceleratorArn": arn})
+
+    def list_tags_for_resource(self, arn):
+        data = self._call("ListTagsForResource", {"ResourceArn": arn})
+        return [Tag(t.get("Key", ""), t.get("Value", "")) for t in data.get("Tags", [])]
+
+    def tag_resource(self, arn, tags):
+        self._call(
+            "TagResource",
+            {
+                "ResourceArn": arn,
+                "Tags": [{"Key": t.key, "Value": t.value} for t in tags],
+            },
+        )
+
+    # listeners
+    def list_listeners(self, accelerator_arn, max_results, next_token):
+        payload: dict = {"AcceleratorArn": accelerator_arn, "MaxResults": max_results}
+        if next_token:
+            payload["NextToken"] = next_token
+        data = self._call("ListListeners", payload)
+        return (
+            [_listener_from_json(l) for l in data.get("Listeners", [])],
+            data.get("NextToken"),
+        )
+
+    def create_listener(self, accelerator_arn, port_ranges, protocol, client_affinity):
+        data = self._call(
+            "CreateListener",
+            {
+                "AcceleratorArn": accelerator_arn,
+                "PortRanges": [
+                    {"FromPort": p.from_port, "ToPort": p.to_port} for p in port_ranges
+                ],
+                "Protocol": protocol,
+                "ClientAffinity": client_affinity,
+            },
+        )
+        return _listener_from_json(data.get("Listener", {}))
+
+    def update_listener(self, listener_arn, port_ranges, protocol, client_affinity):
+        data = self._call(
+            "UpdateListener",
+            {
+                "ListenerArn": listener_arn,
+                "PortRanges": [
+                    {"FromPort": p.from_port, "ToPort": p.to_port} for p in port_ranges
+                ],
+                "Protocol": protocol,
+                "ClientAffinity": client_affinity,
+            },
+        )
+        return _listener_from_json(data.get("Listener", {}))
+
+    def delete_listener(self, arn):
+        self._call("DeleteListener", {"ListenerArn": arn})
+
+    # endpoint groups
+    def list_endpoint_groups(self, listener_arn, max_results, next_token):
+        payload: dict = {"ListenerArn": listener_arn, "MaxResults": max_results}
+        if next_token:
+            payload["NextToken"] = next_token
+        data = self._call("ListEndpointGroups", payload)
+        return (
+            [_endpoint_group_from_json(g) for g in data.get("EndpointGroups", [])],
+            data.get("NextToken"),
+        )
+
+    def describe_endpoint_group(self, arn):
+        data = self._call("DescribeEndpointGroup", {"EndpointGroupArn": arn})
+        return _endpoint_group_from_json(data.get("EndpointGroup", {}))
+
+    def create_endpoint_group(self, listener_arn, endpoint_group_region, endpoint_configurations):
+        data = self._call(
+            "CreateEndpointGroup",
+            {
+                "ListenerArn": listener_arn,
+                "EndpointGroupRegion": endpoint_group_region,
+                "EndpointConfigurations": _endpoint_configurations_json(
+                    endpoint_configurations
+                ),
+            },
+        )
+        return _endpoint_group_from_json(data.get("EndpointGroup", {}))
+
+    def update_endpoint_group(self, arn, endpoint_configurations):
+        data = self._call(
+            "UpdateEndpointGroup",
+            {
+                "EndpointGroupArn": arn,
+                "EndpointConfigurations": _endpoint_configurations_json(
+                    endpoint_configurations
+                ),
+            },
+        )
+        return _endpoint_group_from_json(data.get("EndpointGroup", {}))
+
+    def delete_endpoint_group(self, arn):
+        self._call("DeleteEndpointGroup", {"EndpointGroupArn": arn})
+
+    def add_endpoints(self, arn, endpoint_configurations):
+        data = self._call(
+            "AddEndpoints",
+            {
+                "EndpointGroupArn": arn,
+                "EndpointConfigurations": _endpoint_configurations_json(
+                    endpoint_configurations
+                ),
+            },
+        )
+        return [
+            EndpointDescription(
+                endpoint_id=d.get("EndpointId", ""),
+                weight=d.get("Weight"),
+                client_ip_preservation_enabled=bool(
+                    d.get("ClientIPPreservationEnabled", False)
+                ),
+            )
+            for d in data.get("EndpointDescriptions", [])
+        ]
+
+    def remove_endpoints(self, arn, endpoint_ids):
+        self._call(
+            "RemoveEndpoints",
+            {
+                "EndpointGroupArn": arn,
+                "EndpointIdentifiers": [
+                    {"EndpointId": endpoint_id} for endpoint_id in endpoint_ids
+                ],
+            },
+        )
+
+
+# ---------------------------------------------------------------------------
+# ELBv2 (Query protocol, XML)
+# ---------------------------------------------------------------------------
+
+
+def _xml_strip_ns(root: ET.Element) -> ET.Element:
+    for element in root.iter():
+        if "}" in element.tag:
+            element.tag = element.tag.split("}", 1)[1]
+    return root
+
+
+def _xml_error(status: int, body: bytes) -> AWSAPIError:
+    try:
+        root = _xml_strip_ns(ET.fromstring(body))
+        code = root.findtext(".//Code") or "UnknownError"
+        message = root.findtext(".//Message") or ""
+        return AWSAPIError(code, message)
+    except ET.ParseError:
+        return AWSAPIError("UnknownError", body[:200].decode(errors="replace"))
+
+
+class RealELBv2API(ELBv2API):
+    def __init__(self, region: str, credentials=None, transport=None, endpoint=None):
+        self._client = _SignedClient(
+            "elasticloadbalancing",
+            region,
+            endpoint or f"https://elasticloadbalancing.{region}.amazonaws.com",
+            credentials,
+            transport,
+        )
+
+    def describe_load_balancers(self, names):
+        params = {"Action": "DescribeLoadBalancers", "Version": ELBV2_API_VERSION}
+        for i, name in enumerate(names, start=1):
+            params[f"Names.member.{i}"] = name
+        body = urllib.parse.urlencode(params).encode()
+        status, response = self._client.request(
+            "POST",
+            "/",
+            {"Content-Type": "application/x-www-form-urlencoded"},
+            body,
+        )
+        if status >= 300:
+            raise _xml_error(status, response)
+        root = _xml_strip_ns(ET.fromstring(response))
+        out = []
+        for member in root.findall(".//LoadBalancers/member"):
+            out.append(
+                LoadBalancer(
+                    load_balancer_arn=member.findtext("LoadBalancerArn", ""),
+                    load_balancer_name=member.findtext("LoadBalancerName", ""),
+                    dns_name=member.findtext("DNSName", ""),
+                    state_code=member.findtext("State/Code", ""),
+                    type=member.findtext("Type", ""),
+                    scheme=member.findtext("Scheme", ""),
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Route53 (REST XML)
+# ---------------------------------------------------------------------------
+
+_R53_NS = "https://route53.amazonaws.com/doc/2013-04-01/"
+
+
+def _record_set_to_xml(record: ResourceRecordSet) -> ET.Element:
+    rrs = ET.Element("ResourceRecordSet")
+    ET.SubElement(rrs, "Name").text = record.name
+    ET.SubElement(rrs, "Type").text = record.type
+    if record.alias_target is not None:
+        alias = ET.SubElement(rrs, "AliasTarget")
+        ET.SubElement(alias, "HostedZoneId").text = record.alias_target.hosted_zone_id
+        ET.SubElement(alias, "DNSName").text = record.alias_target.dns_name
+        ET.SubElement(alias, "EvaluateTargetHealth").text = (
+            "true" if record.alias_target.evaluate_target_health else "false"
+        )
+    if record.ttl is not None:
+        ET.SubElement(rrs, "TTL").text = str(record.ttl)
+    if record.resource_records:
+        records = ET.SubElement(rrs, "ResourceRecords")
+        for rr in record.resource_records:
+            ET.SubElement(
+                ET.SubElement(records, "ResourceRecord"), "Value"
+            ).text = rr.value
+    return rrs
+
+
+def _record_set_from_xml(element: ET.Element) -> ResourceRecordSet:
+    alias = element.find("AliasTarget")
+    ttl = element.findtext("TTL")
+    return ResourceRecordSet(
+        name=element.findtext("Name", ""),
+        type=element.findtext("Type", ""),
+        ttl=int(ttl) if ttl else None,
+        resource_records=[
+            ResourceRecord(value.findtext("Value", ""))
+            for value in element.findall("ResourceRecords/ResourceRecord")
+        ],
+        alias_target=(
+            AliasTarget(
+                dns_name=alias.findtext("DNSName", ""),
+                evaluate_target_health=alias.findtext("EvaluateTargetHealth") == "true",
+                hosted_zone_id=alias.findtext("HostedZoneId", ""),
+            )
+            if alias is not None
+            else None
+        ),
+    )
+
+
+class RealRoute53API(Route53API):
+    def __init__(self, credentials=None, transport=None, endpoint=None):
+        # Route53 is global; requests are signed against us-east-1
+        self._client = _SignedClient(
+            "route53",
+            "us-east-1",
+            endpoint or "https://route53.amazonaws.com",
+            credentials,
+            transport,
+        )
+
+    def _get(self, path: str) -> ET.Element:
+        status, response = self._client.request("GET", path, {}, b"")
+        if status >= 300:
+            raise _xml_error(status, response)
+        return _xml_strip_ns(ET.fromstring(response))
+
+    @staticmethod
+    def _zone_from_xml(element: ET.Element) -> HostedZone:
+        return HostedZone(
+            id=element.findtext("Id", ""), name=element.findtext("Name", "")
+        )
+
+    def list_hosted_zones(self, max_items, marker):
+        query = {"maxitems": str(max_items)}
+        if marker:
+            query["marker"] = marker
+        root = self._get(
+            f"/{ROUTE53_API_VERSION}/hostedzone?{urllib.parse.urlencode(query)}"
+        )
+        zones = [
+            self._zone_from_xml(z) for z in root.findall(".//HostedZones/HostedZone")
+        ]
+        next_marker = root.findtext("NextMarker")
+        return zones, next_marker
+
+    def list_hosted_zones_by_name(self, dns_name, max_items):
+        query = urllib.parse.urlencode({"dnsname": dns_name, "maxitems": str(max_items)})
+        root = self._get(f"/{ROUTE53_API_VERSION}/hostedzonesbyname?{query}")
+        return [
+            self._zone_from_xml(z) for z in root.findall(".//HostedZones/HostedZone")
+        ]
+
+    def list_resource_record_sets(self, hosted_zone_id, max_items, start_record_name):
+        zone = hosted_zone_id.split("/")[-1]
+        query = {"maxitems": str(max_items)}
+        if start_record_name:
+            query["name"] = start_record_name
+        root = self._get(
+            f"/{ROUTE53_API_VERSION}/hostedzone/{zone}/rrset?{urllib.parse.urlencode(query)}"
+        )
+        records = [
+            _record_set_from_xml(r)
+            for r in root.findall(".//ResourceRecordSets/ResourceRecordSet")
+        ]
+        next_name = root.findtext("NextRecordName")
+        is_truncated = root.findtext("IsTruncated") == "true"
+        return records, (next_name if is_truncated else None)
+
+    def change_resource_record_sets(self, hosted_zone_id, changes: list[Change]):
+        zone = hosted_zone_id.split("/")[-1]
+        request = ET.Element("ChangeResourceRecordSetsRequest", xmlns=_R53_NS)
+        batch = ET.SubElement(request, "ChangeBatch")
+        changes_el = ET.SubElement(batch, "Changes")
+        for change in changes:
+            change_el = ET.SubElement(changes_el, "Change")
+            ET.SubElement(change_el, "Action").text = change.action
+            change_el.append(_record_set_to_xml(change.record_set))
+        body = ET.tostring(request, encoding="utf-8", xml_declaration=True)
+        status, response = self._client.request(
+            "POST",
+            f"/{ROUTE53_API_VERSION}/hostedzone/{zone}/rrset",
+            {"Content-Type": "application/xml"},
+            body,
+        )
+        if status >= 300:
+            raise _xml_error(status, response)
+
+
+@dataclass
+class RealAWSClients:
+    ga: RealGlobalAcceleratorAPI
+    elbv2: RealELBv2API
+    route53: RealRoute53API
+
+    @classmethod
+    def from_environment(cls, region: str) -> "RealAWSClients":
+        # one shared provider: resolution happens lazily on first call
+        # and refreshes automatically for session credentials
+        provider = CredentialProvider()
+        return cls(
+            ga=RealGlobalAcceleratorAPI(provider),
+            elbv2=RealELBv2API(region, provider),
+            route53=RealRoute53API(provider),
+        )

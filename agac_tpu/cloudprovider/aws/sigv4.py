@@ -1,0 +1,232 @@
+"""AWS Signature Version 4 request signing and credential resolution,
+stdlib only.
+
+The authentication layer the reference gets from aws-sdk-go-v2's
+``config.LoadDefaultConfig`` (``pkg/cloudprovider/aws/aws.go:18-38``).
+Credential resolution order: environment (``AWS_ACCESS_KEY_ID`` /
+``AWS_SECRET_ACCESS_KEY`` / ``AWS_SESSION_TOKEN``) → IRSA web
+identity (``AWS_ROLE_ARN`` + ``AWS_WEB_IDENTITY_TOKEN_FILE``, the
+standard EKS service-account setup, exchanged through STS
+``AssumeRoleWithWebIdentity`` — an unsigned call) → shared
+credentials file (``~/.aws/credentials``, profile from
+``AWS_PROFILE``).  ``CredentialProvider`` caches and transparently
+re-resolves expiring session credentials, which a long-running
+controller needs.
+"""
+
+from __future__ import annotations
+
+import configparser
+import datetime
+import hashlib
+import hmac
+import os
+import threading
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+@dataclass
+class Credentials:
+    access_key_id: str
+    secret_access_key: str
+    session_token: Optional[str] = None
+    expiration: Optional[float] = None  # unix epoch; None = static
+
+STS_ENDPOINT = "https://sts.amazonaws.com/"
+_EXPIRY_MARGIN = 300.0  # refresh 5 min before expiry
+
+
+def _assume_role_with_web_identity(
+    role_arn: str, token_file: str, urlopen=urllib.request.urlopen
+) -> Credentials:
+    """IRSA: exchange the projected service-account token for session
+    credentials.  AssumeRoleWithWebIdentity takes no signature."""
+    with open(token_file) as fh:
+        token = fh.read().strip()
+    body = urllib.parse.urlencode(
+        {
+            "Action": "AssumeRoleWithWebIdentity",
+            "Version": "2011-06-15",
+            "RoleArn": role_arn,
+            "RoleSessionName": os.environ.get(
+                "AWS_ROLE_SESSION_NAME", "aws-global-accelerator-controller"
+            ),
+            "WebIdentityToken": token,
+        }
+    ).encode()
+    request = urllib.request.Request(
+        STS_ENDPOINT,
+        data=body,
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+        method="POST",
+    )
+    with urlopen(request, timeout=30) as response:
+        payload = response.read()
+    root = ET.fromstring(payload)
+    for element in root.iter():
+        if "}" in element.tag:
+            element.tag = element.tag.split("}", 1)[1]
+    creds = root.find(".//Credentials")
+    if creds is None:
+        raise RuntimeError("STS AssumeRoleWithWebIdentity returned no credentials")
+    expiration_text = creds.findtext("Expiration", "")
+    expiration = None
+    if expiration_text:
+        expiration = (
+            datetime.datetime.strptime(expiration_text, "%Y-%m-%dT%H:%M:%SZ")
+            .replace(tzinfo=datetime.timezone.utc)
+            .timestamp()
+        )
+    return Credentials(
+        access_key_id=creds.findtext("AccessKeyId", ""),
+        secret_access_key=creds.findtext("SecretAccessKey", ""),
+        session_token=creds.findtext("SessionToken"),
+        expiration=expiration,
+    )
+
+
+def resolve_credentials(urlopen=urllib.request.urlopen) -> Credentials:
+    access_key = os.environ.get("AWS_ACCESS_KEY_ID")
+    secret_key = os.environ.get("AWS_SECRET_ACCESS_KEY")
+    if access_key and secret_key:
+        return Credentials(access_key, secret_key, os.environ.get("AWS_SESSION_TOKEN"))
+    role_arn = os.environ.get("AWS_ROLE_ARN")
+    token_file = os.environ.get("AWS_WEB_IDENTITY_TOKEN_FILE")
+    if role_arn and token_file:
+        return _assume_role_with_web_identity(role_arn, token_file, urlopen)
+    path = os.environ.get(
+        "AWS_SHARED_CREDENTIALS_FILE", os.path.expanduser("~/.aws/credentials")
+    )
+    profile = os.environ.get("AWS_PROFILE", "default")
+    parser = configparser.ConfigParser()
+    if parser.read(path) and parser.has_section(profile):
+        section = parser[profile]
+        if "aws_access_key_id" in section and "aws_secret_access_key" in section:
+            return Credentials(
+                section["aws_access_key_id"],
+                section["aws_secret_access_key"],
+                section.get("aws_session_token"),
+            )
+    raise RuntimeError(
+        "no AWS credentials found (env AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY, "
+        f"IRSA AWS_ROLE_ARN/AWS_WEB_IDENTITY_TOKEN_FILE, or {path} profile {profile!r})"
+    )
+
+
+class CredentialProvider:
+    """Caches credentials and re-resolves them before expiry; safe to
+    share across service clients and threads."""
+
+    def __init__(
+        self,
+        static: Optional[Credentials] = None,
+        resolver: Callable[[], Credentials] = resolve_credentials,
+        clock: Callable[[], float] = None,
+    ):
+        import time as _time
+
+        self._static = static
+        self._resolver = resolver
+        self._clock = clock or _time.time
+        self._cached: Optional[Credentials] = static
+        self._lock = threading.Lock()
+
+    def get(self) -> Credentials:
+        with self._lock:
+            cached = self._cached
+            if cached is not None and (
+                cached.expiration is None
+                or cached.expiration - self._clock() > _EXPIRY_MARGIN
+            ):
+                return cached
+            if self._static is not None and self._static.expiration is None:
+                return self._static
+            self._cached = self._resolver()
+            return self._cached
+
+
+def _sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac(key: bytes, message: str) -> bytes:
+    return hmac.new(key, message.encode(), hashlib.sha256).digest()
+
+
+def _canonical_query(query: str) -> str:
+    pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    encoded = [
+        (urllib.parse.quote(k, safe="-_.~"), urllib.parse.quote(v, safe="-_.~"))
+        for k, v in pairs
+    ]
+    return "&".join(f"{k}={v}" for k, v in sorted(encoded))
+
+
+def sign_request(
+    method: str,
+    url: str,
+    headers: dict[str, str],
+    body: bytes,
+    service: str,
+    region: str,
+    credentials: Credentials,
+    now: Optional[datetime.datetime] = None,
+) -> dict[str, str]:
+    """Return ``headers`` plus the SigV4 ``Authorization``,
+    ``X-Amz-Date`` (and session-token) headers for the request."""
+    parsed = urllib.parse.urlsplit(url)
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date_stamp = now.strftime("%Y%m%d")
+
+    signed = dict(headers)
+    signed["Host"] = parsed.netloc
+    signed["X-Amz-Date"] = amz_date
+    if credentials.session_token:
+        signed["X-Amz-Security-Token"] = credentials.session_token
+
+    payload_hash = _sha256_hex(body or b"")
+    lower = {k.lower(): v.strip() for k, v in signed.items()}
+    signed_header_names = ";".join(sorted(lower))
+    canonical_headers = "".join(f"{k}:{lower[k]}\n" for k in sorted(lower))
+    canonical_request = "\n".join(
+        [
+            method,
+            urllib.parse.quote(parsed.path or "/", safe="/-_.~%"),
+            _canonical_query(parsed.query),
+            canonical_headers,
+            signed_header_names,
+            payload_hash,
+        ]
+    )
+    scope = f"{date_stamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            _sha256_hex(canonical_request.encode()),
+        ]
+    )
+    key = _hmac(
+        _hmac(
+            _hmac(
+                _hmac(f"AWS4{credentials.secret_access_key}".encode(), date_stamp),
+                region,
+            ),
+            service,
+        ),
+        "aws4_request",
+    )
+    signature = hmac.new(key, string_to_sign.encode(), hashlib.sha256).hexdigest()
+    signed["Authorization"] = (
+        "AWS4-HMAC-SHA256 "
+        f"Credential={credentials.access_key_id}/{scope}, "
+        f"SignedHeaders={signed_header_names}, "
+        f"Signature={signature}"
+    )
+    return signed

@@ -1,0 +1,200 @@
+"""CLI entry: ``controller``, ``webhook``, ``version``, ``manifests``.
+
+Capability parity with the reference's cobra CLI (``cmd/``, 199 LoC +
+``main.go``): subcommand structure, klog-style ``-v`` verbosity on the
+root, kubeconfig resolution order flag → ``$KUBECONFIG`` →
+``~/.kube/config`` → in-cluster (``cmd/controller/controller.go:84-98``),
+``POD_NAMESPACE`` for the leader-election lease namespace
+(``controller.go:55-58``), and version stamping.  ``manifests`` is the
+``make manifests`` analog (the reference generates its config/ tree
+with controller-gen).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .. import VERSION, klog
+
+REVISION = os.environ.get("AGAC_BUILD_REVISION", "dev")
+BUILD = os.environ.get("AGAC_BUILD_DATE", "unknown")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="aws-global-accelerator-controller",
+        description="Manage AWS Global Accelerator and Route53 from Kubernetes",
+    )
+    parser.add_argument(
+        "-v", "--verbosity", type=int, default=0, help="klog-style log verbosity"
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    controller = sub.add_parser("controller", help="Start controller")
+    controller.add_argument(
+        "-w", "--workers", type=int, default=1,
+        help="Concurrent workers number for controller.",
+    )
+    controller.add_argument(
+        "-c", "--cluster-name", default="default",
+        help="Owner cluster name which is used in resource tags.",
+    )
+    controller.add_argument(
+        "--kubeconfig", default="",
+        help="Path to a kubeconfig. Only required if out-of-cluster.",
+    )
+    controller.add_argument(
+        "--master", default="",
+        help="The address of the Kubernetes API server. Overrides any value in kubeconfig.",
+    )
+    controller.add_argument(
+        "--disable-leader-election", action="store_true",
+        help="Run without acquiring the leader lease (single-replica setups).",
+    )
+
+    webhook = sub.add_parser("webhook", help="Start webhook server")
+    webhook.add_argument(
+        "--tls-cert-file", default="",
+        help="File containing the x509 Certificate for HTTPS.",
+    )
+    webhook.add_argument(
+        "--tls-private-key-file", default="",
+        help="File containing the x509 private key to --tls-cert-file.",
+    )
+    webhook.add_argument("--port", type=int, default=8443, help="Webhook server port.")
+    webhook.add_argument(
+        "--ssl", default="true", choices=["true", "false"],
+        help="Webhook server use SSL.",
+    )
+
+    sub.add_parser("version", help="Print the version number")
+
+    manifests = sub.add_parser(
+        "manifests", help="Generate CRD/webhook/RBAC/sample manifests"
+    )
+    manifests.add_argument("-o", "--output", default="config", help="Output directory.")
+
+    return parser
+
+
+def resolve_kubeconfig(flag_value: str) -> str:
+    """flag → $KUBECONFIG → ~/.kube/config → "" (in-cluster)."""
+    if flag_value:
+        return flag_value
+    env = os.environ.get("KUBECONFIG", "")
+    if env:
+        return env
+    default = os.path.expanduser("~/.kube/config")
+    if os.path.exists(default):
+        return default
+    return ""
+
+
+def run_controller(args) -> int:
+    from ..cluster.rest import build_client
+    from ..controllers import (
+        EndpointGroupBindingConfig,
+        GlobalAcceleratorConfig,
+        Route53Config,
+    )
+    from ..leaderelection import LeaderElection
+    from ..manager import ControllerConfig, Manager
+    from ..signals import setup_signal_handler
+
+    kubeconfig = resolve_kubeconfig(args.kubeconfig)
+    if kubeconfig:
+        klog.infof("Using kubeconfig: %s", kubeconfig)
+    else:
+        klog.info("Using in-cluster config")
+    try:
+        client = build_client(kubeconfig, args.master)
+    except Exception as err:
+        klog.errorf("Error building rest config: %s", err)
+        return 1
+
+    namespace = os.environ.get("POD_NAMESPACE") or "default"
+    config = ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(
+            workers=args.workers, cluster_name=args.cluster_name
+        ),
+        route53=Route53Config(workers=args.workers, cluster_name=args.cluster_name),
+        endpoint_group_binding=EndpointGroupBindingConfig(workers=args.workers),
+    )
+    stop = setup_signal_handler()
+
+    from ..cloudprovider.aws.factory import real_cloud_factory
+
+    def run_manager(stop_event):
+        Manager().run(
+            client, config, stop_event, cloud_factory=real_cloud_factory, block=True
+        )
+
+    if args.disable_leader_election:
+        run_manager(stop)
+        return 0
+
+    election = LeaderElection("aws-global-accelerator-controller", namespace)
+    election.run(
+        client,
+        run_manager,
+        stop,
+        # lease lost: exit so the kubelet restarts us as a follower
+        # (reference ``leaderelection.go:70-73``)
+        on_stopped_leading=lambda: os._exit(0),
+    )
+    return 0
+
+
+def run_webhook(args) -> int:
+    from ..webhook import Server
+
+    use_ssl = args.ssl == "true"
+    if use_ssl and (not args.tls_cert_file or not args.tls_private_key_file):
+        print(
+            "You must set --tls-cert-file and --tls-private-key-file when you use SSL",
+            file=sys.stderr,
+        )
+        return 2
+    Server(
+        args.port,
+        args.tls_cert_file if use_ssl else "",
+        args.tls_private_key_file if use_ssl else "",
+    )
+    return 0
+
+
+def run_version(_args) -> int:
+    print(f"Version : {VERSION}")
+    print(f"Revision: {REVISION}")
+    print(f"Build   : {BUILD}")
+    return 0
+
+
+def run_manifests(args) -> int:
+    from ..manifests import write_manifests
+
+    for path in write_manifests(args.output):
+        print(os.path.join(args.output, path))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    klog.init(verbosity=args.verbosity)
+    if args.command == "controller":
+        return run_controller(args)
+    if args.command == "webhook":
+        return run_webhook(args)
+    if args.command == "version":
+        return run_version(args)
+    if args.command == "manifests":
+        return run_manifests(args)
+    parser.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,198 @@
+"""Lease-based leader election — single-active-controller HA.
+
+Capability parity with the reference's ``pkg/leaderelection/`` (85
+LoC), which wraps client-go's LeaseLock elector: a coordination Lease
+object named after the controller, uuid identity, LeaseDuration 60 s /
+RenewDeadline 15 s / RetryPeriod 5 s (``leaderelection.go:61-63``),
+the run callback invoked only once leadership is acquired, and
+``on_stopped_leading`` fired when the lease cannot be renewed within
+the renew deadline — the reference exits the process there
+(``leaderelection.go:70-73``) and the CLI layer here does the same.
+
+The elector speaks to the apiserver only through ``ClusterClient``
+(Lease get/create/update with optimistic concurrency), so it runs
+against both the fake and the REST client.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from . import klog
+from .cluster import ClusterClient, Lease
+from .cluster.objects import LeaseSpec, ObjectMeta
+from .errors import AlreadyExistsError, ConflictError, NotFoundError
+
+
+def _now_rfc3339() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%fZ"
+    )
+
+
+def _parse_rfc3339(value: str) -> float:
+    import datetime
+
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            return (
+                datetime.datetime.strptime(value, fmt)
+                .replace(tzinfo=datetime.timezone.utc)
+                .timestamp()
+            )
+        except ValueError:
+            continue
+    return 0.0
+
+
+@dataclass
+class LeaderElectionConfig:
+    lease_duration: float = 60.0
+    renew_deadline: float = 15.0
+    retry_period: float = 5.0
+
+
+class LeaderElection:
+    def __init__(
+        self,
+        name: str,
+        namespace: str,
+        config: Optional[LeaderElectionConfig] = None,
+        identity: Optional[str] = None,
+    ):
+        self.name = name
+        self.namespace = namespace
+        self.config = config or LeaderElectionConfig()
+        self.identity = identity or str(uuid.uuid4())
+        self._leading = threading.Event()
+
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        client: ClusterClient,
+        run_fn: Callable[[threading.Event], None],
+        stop: threading.Event,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        on_new_leader: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        """Block until leadership, run ``run_fn(stop)``, and keep the
+        lease renewed in the background; if renewal fails past the
+        renew deadline, fire ``on_stopped_leading`` (process exit in
+        the CLI) and set ``stop``."""
+        klog.infof("leader election id: %s", self.identity)
+        last_reported_leader = ""
+        while not stop.is_set():
+            acquired, holder = self._try_acquire_or_renew(client)
+            if acquired:
+                break
+            if holder and holder != last_reported_leader:
+                last_reported_leader = holder
+                klog.infof("new leader elected: %s", holder)
+                if on_new_leader:
+                    on_new_leader(holder)
+            stop.wait(self.config.retry_period)
+        if stop.is_set():
+            return
+
+        self._leading.set()
+        klog.infof("successfully acquired lease %s/%s", self.namespace, self.name)
+
+        renew_failed = threading.Event()
+
+        def renew_loop():
+            deadline = time.monotonic() + self.config.renew_deadline
+            while not stop.is_set():
+                acquired, _ = self._try_acquire_or_renew(client)
+                if acquired:
+                    deadline = time.monotonic() + self.config.renew_deadline
+                elif time.monotonic() >= deadline:
+                    klog.infof("leader lost: %s", self.identity)
+                    self._leading.clear()
+                    renew_failed.set()
+                    stop.set()
+                    if on_stopped_leading:
+                        on_stopped_leading()
+                    return
+
+                stop.wait(self.config.retry_period)
+
+        renewer = threading.Thread(target=renew_loop, daemon=True, name="lease-renew")
+        renewer.start()
+        try:
+            run_fn(stop)
+        finally:
+            stop.set()
+            renewer.join(timeout=self.config.retry_period + 1)
+            # ReleaseOnCancel, but only AFTER the run callback has fully
+            # returned: releasing earlier would let a standby start
+            # reconciling while this process's workers are still
+            # draining (split-brain).  No release when the lease was
+            # lost — someone else already holds it.
+            if not renew_failed.is_set():
+                self._release(client)
+            self._leading.clear()
+
+    # ------------------------------------------------------------------
+    def _try_acquire_or_renew(self, client: ClusterClient) -> tuple[bool, str]:
+        """Returns (we_are_leader, current_holder)."""
+        now = _now_rfc3339()
+        try:
+            lease = client.get("Lease", self.namespace, self.name)
+        except NotFoundError:
+            lease = Lease(
+                metadata=ObjectMeta(name=self.name, namespace=self.namespace),
+                spec=LeaseSpec(
+                    holder_identity=self.identity,
+                    lease_duration_seconds=int(self.config.lease_duration),
+                    acquire_time=now,
+                    renew_time=now,
+                    lease_transitions=0,
+                ),
+            )
+            try:
+                client.create("Lease", lease)
+                return True, self.identity
+            except AlreadyExistsError:
+                return False, ""
+        except Exception as err:
+            klog.errorf("error retrieving lease %s/%s: %s", self.namespace, self.name, err)
+            return False, ""
+
+        holder = lease.spec.holder_identity or ""
+        if holder != self.identity:
+            renew_time = _parse_rfc3339(lease.spec.renew_time or "")
+            duration = lease.spec.lease_duration_seconds or self.config.lease_duration
+            if renew_time + duration > time.time():
+                return False, holder  # lease is held and fresh
+            lease.spec.lease_transitions += 1
+            lease.spec.acquire_time = now
+        lease.spec.holder_identity = self.identity
+        lease.spec.renew_time = now
+        lease.spec.lease_duration_seconds = int(self.config.lease_duration)
+        try:
+            client.update("Lease", lease)
+            return True, self.identity
+        except (ConflictError, NotFoundError):
+            return False, holder
+        except Exception as err:
+            klog.errorf("error updating lease: %s", err)
+            return False, holder
+
+    def _release(self, client: ClusterClient) -> None:
+        """ReleaseOnCancel analog: clear the holder on clean shutdown."""
+        try:
+            lease = client.get("Lease", self.namespace, self.name)
+            if lease.spec.holder_identity == self.identity:
+                lease.spec.holder_identity = None
+                client.update("Lease", lease)
+        except Exception:
+            pass

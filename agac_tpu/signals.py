@@ -1,0 +1,32 @@
+"""Signal handling.
+
+Capability parity with the reference's ``pkg/signals/signals.go:16-30``:
+SIGINT/SIGTERM set the returned stop event; a second signal hard-exits
+with code 1; installing the handler twice raises.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+_installed = False
+
+
+def setup_signal_handler() -> threading.Event:
+    global _installed
+    if _installed:
+        raise RuntimeError("signal handler already installed")  # panics when called twice
+    _installed = True
+
+    stop = threading.Event()
+
+    def handler(signum, frame):
+        if stop.is_set():
+            os._exit(1)  # second signal: exit directly
+        stop.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    return stop

@@ -1,0 +1,153 @@
+"""CLI tests (subcommands, flag defaults, kubeconfig resolution,
+webhook SSL validation) and manifest-generation tests (structural
+equivalence with the reference's generated config/ tree)."""
+
+import os
+import subprocess
+import sys
+
+import yaml
+
+from agac_tpu.cmd.root import build_parser, resolve_kubeconfig
+from agac_tpu.manifests import (
+    crd_manifest,
+    rbac_manifest,
+    sample_manifests,
+    validating_webhook_manifest,
+    write_manifests,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "agac_tpu", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+
+
+class TestCLI:
+    def test_version_subcommand(self):
+        result = run_cli("version")
+        assert result.returncode == 0
+        assert "Version : 0.1.0" in result.stdout
+        assert "Revision:" in result.stdout
+
+    def test_help_lists_subcommands(self):
+        result = run_cli("--help")
+        for sub in ("controller", "webhook", "version", "manifests"):
+            assert sub in result.stdout
+
+    def test_controller_flag_defaults(self):
+        args = build_parser().parse_args(["controller"])
+        assert args.workers == 1
+        assert args.cluster_name == "default"
+        assert args.kubeconfig == ""
+        assert args.master == ""
+
+    def test_controller_short_flags(self):
+        args = build_parser().parse_args(["controller", "-w", "4", "-c", "prod"])
+        assert args.workers == 4
+        assert args.cluster_name == "prod"
+
+    def test_webhook_requires_tls_files_when_ssl(self):
+        result = run_cli("webhook")  # ssl defaults to true, no certs
+        assert result.returncode == 2
+        assert "--tls-cert-file" in result.stderr
+
+    def test_webhook_flag_defaults(self):
+        args = build_parser().parse_args(["webhook"])
+        assert args.port == 8443
+        assert args.ssl == "true"
+
+    def test_kubeconfig_resolution_order(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("KUBECONFIG", raising=False)
+        assert resolve_kubeconfig("/explicit/path") == "/explicit/path"
+        monkeypatch.setenv("KUBECONFIG", "/from/env")
+        assert resolve_kubeconfig("") == "/from/env"
+        monkeypatch.delenv("KUBECONFIG")
+        fake_home = tmp_path / "home"
+        (fake_home / ".kube").mkdir(parents=True)
+        (fake_home / ".kube" / "config").write_text("{}")
+        monkeypatch.setenv("HOME", str(fake_home))
+        assert resolve_kubeconfig("") == str(fake_home / ".kube" / "config")
+
+    def test_controller_without_cluster_errors_cleanly(self, tmp_path):
+        env = dict(os.environ, HOME=str(tmp_path), KUBECONFIG="")
+        env.pop("KUBERNETES_SERVICE_HOST", None)
+        result = subprocess.run(
+            [sys.executable, "-m", "agac_tpu", "controller"],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            env=env,
+            timeout=60,
+        )
+        assert result.returncode == 1
+        assert "Error building rest config" in result.stderr
+
+
+class TestManifests:
+    def test_crd_matches_reference_shape(self):
+        crd = crd_manifest()
+        assert crd["metadata"]["name"] == "endpointgroupbindings.operator.h3poteto.dev"
+        version = crd["spec"]["versions"][0]
+        assert version["name"] == "v1alpha1"
+        assert version["subresources"] == {"status": {}}
+        schema = version["schema"]["openAPIV3Schema"]
+        spec_schema = schema["properties"]["spec"]
+        assert spec_schema["required"] == ["endpointGroupArn"]
+        assert spec_schema["properties"]["clientIPPreservation"]["default"] is False
+        assert spec_schema["properties"]["weight"]["nullable"] is True
+        status_schema = schema["properties"]["status"]
+        assert status_schema["required"] == ["observedGeneration"]
+        columns = [c["name"] for c in version["additionalPrinterColumns"]]
+        assert columns == ["EndpointGroupArn", "EndpointIds", "Age"]
+
+    def test_webhook_manifest_matches_reference_shape(self):
+        hook = validating_webhook_manifest()["webhooks"][0]
+        assert hook["failurePolicy"] == "Fail"
+        assert hook["clientConfig"]["service"]["path"] == "/validate-endpointgroupbinding"
+        assert hook["rules"][0]["operations"] == ["CREATE", "UPDATE"]
+        assert hook["rules"][0]["resources"] == ["endpointgroupbindings"]
+        assert hook["sideEffects"] == "None"
+
+    def test_rbac_covers_required_access(self):
+        rules = rbac_manifest()["rules"]
+        by_resource = {}
+        for rule in rules:
+            for resource in rule["resources"]:
+                by_resource.setdefault(resource, set()).update(rule["verbs"])
+        assert {"get", "list", "watch"} <= by_resource["services"]
+        assert {"get", "list", "watch"} <= by_resource["ingresses"]
+        assert "create" in by_resource["events"]
+        assert "update" in by_resource["leases"]
+        assert "update" in by_resource["endpointgroupbindings"]
+        assert "update" in by_resource["endpointgroupbindings/status"]
+
+    def test_write_manifests_round_trip(self, tmp_path):
+        written = write_manifests(str(tmp_path))
+        assert "crd/operator.h3poteto.dev_endpointgroupbindings.yaml" in written
+        assert "webhook/manifests.yaml" in written
+        assert "rbac/role.yaml" in written
+        for rel in written:
+            with open(tmp_path / rel) as fh:
+                assert yaml.safe_load(fh)  # valid single-document YAML
+
+    def test_samples_use_annotation_contract(self):
+        samples = sample_manifests()
+        nlb = samples["nlb-public-service.yaml"]
+        annotations = nlb["metadata"]["annotations"]
+        assert (
+            "aws-global-accelerator-controller.h3poteto.dev/global-accelerator-managed"
+            in annotations
+        )
+
+    def test_manifests_cli_writes_tree(self, tmp_path):
+        result = run_cli("manifests", "-o", str(tmp_path))
+        assert result.returncode == 0
+        assert (tmp_path / "rbac" / "role.yaml").exists()

@@ -1,0 +1,126 @@
+"""Credential resolution tests: env, shared file, IRSA web identity
+(stubbed STS), and provider-driven refresh of expiring sessions."""
+
+import contextlib
+import io
+import urllib.parse
+
+import pytest
+
+from agac_tpu.cloudprovider.aws.sigv4 import (
+    CredentialProvider,
+    Credentials,
+    _assume_role_with_web_identity,
+    resolve_credentials,
+)
+
+STS_XML = b"""<AssumeRoleWithWebIdentityResponse xmlns="https://sts.amazonaws.com/doc/2011-06-15/">
+  <AssumeRoleWithWebIdentityResult>
+    <Credentials>
+      <AccessKeyId>ASIAEXAMPLE</AccessKeyId>
+      <SecretAccessKey>secretFromSts</SecretAccessKey>
+      <SessionToken>stsToken</SessionToken>
+      <Expiration>2030-01-01T00:00:00Z</Expiration>
+    </Credentials>
+  </AssumeRoleWithWebIdentityResult>
+</AssumeRoleWithWebIdentityResponse>"""
+
+
+class StubResponse(io.BytesIO):
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *args):
+        self.close()
+
+
+def stub_urlopen(captured):
+    def opener(request, timeout=None):
+        captured.append(request)
+        return StubResponse(STS_XML)
+
+    return opener
+
+
+def test_env_credentials(monkeypatch):
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKID")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+    monkeypatch.setenv("AWS_SESSION_TOKEN", "tok")
+    creds = resolve_credentials()
+    assert creds.access_key_id == "AKID"
+    assert creds.session_token == "tok"
+    assert creds.expiration is None
+
+
+def test_shared_file_credentials(monkeypatch, tmp_path):
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+    monkeypatch.delenv("AWS_ROLE_ARN", raising=False)
+    path = tmp_path / "credentials"
+    path.write_text("[default]\naws_access_key_id = FILEKEY\naws_secret_access_key = filesecret\n")
+    monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(path))
+    creds = resolve_credentials()
+    assert creds.access_key_id == "FILEKEY"
+
+
+def test_no_credentials_raises(monkeypatch, tmp_path):
+    for var in ("AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY", "AWS_ROLE_ARN",
+                "AWS_WEB_IDENTITY_TOKEN_FILE"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(tmp_path / "nope"))
+    with pytest.raises(RuntimeError, match="no AWS credentials"):
+        resolve_credentials()
+
+
+def test_irsa_web_identity(monkeypatch, tmp_path):
+    token_file = tmp_path / "token"
+    token_file.write_text("jwt-token-value")
+    captured = []
+    creds = _assume_role_with_web_identity(
+        "arn:aws:iam::123:role/irsa", str(token_file), urlopen=stub_urlopen(captured)
+    )
+    assert creds.access_key_id == "ASIAEXAMPLE"
+    assert creds.session_token == "stsToken"
+    assert creds.expiration is not None
+    body = dict(urllib.parse.parse_qsl(captured[0].data.decode()))
+    assert body["Action"] == "AssumeRoleWithWebIdentity"
+    assert body["RoleArn"] == "arn:aws:iam::123:role/irsa"
+    assert body["WebIdentityToken"] == "jwt-token-value"
+
+
+def test_irsa_resolution_order(monkeypatch, tmp_path):
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+    token_file = tmp_path / "token"
+    token_file.write_text("jwt")
+    monkeypatch.setenv("AWS_ROLE_ARN", "arn:aws:iam::123:role/irsa")
+    monkeypatch.setenv("AWS_WEB_IDENTITY_TOKEN_FILE", str(token_file))
+    captured = []
+    creds = resolve_credentials(urlopen=stub_urlopen(captured))
+    assert creds.access_key_id == "ASIAEXAMPLE"
+
+
+class TestCredentialProvider:
+    def test_static_credentials_never_refresh(self):
+        calls = []
+        provider = CredentialProvider(
+            static=Credentials("AKID", "secret"),
+            resolver=lambda: calls.append(1) or Credentials("X", "Y"),
+        )
+        assert provider.get().access_key_id == "AKID"
+        assert provider.get().access_key_id == "AKID"
+        assert calls == []
+
+    def test_expiring_credentials_refresh_before_expiry(self):
+        now = [1000.0]
+        sequence = [
+            Credentials("FIRST", "s", expiration=2000.0),
+            Credentials("SECOND", "s", expiration=99999.0),
+        ]
+        provider = CredentialProvider(
+            resolver=lambda: sequence.pop(0), clock=lambda: now[0]
+        )
+        assert provider.get().access_key_id == "FIRST"
+        assert provider.get().access_key_id == "FIRST"  # cached
+        now[0] = 1800.0  # within 5-min margin of the 2000.0 expiry
+        assert provider.get().access_key_id == "SECOND"

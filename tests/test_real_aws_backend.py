@@ -1,0 +1,321 @@
+"""Real AWS backend tests with stub transports: SigV4 against the
+official AWS test vector, JSON 1.1 / Query-XML / REST-XML request
+construction and response parsing, and error-code mapping."""
+
+import datetime
+import json
+import urllib.parse
+
+import pytest
+
+from agac_tpu.cloudprovider.aws.errors import (
+    AWSAPIError,
+    EndpointGroupNotFoundException,
+    ListenerNotFoundException,
+)
+from agac_tpu.cloudprovider.aws.real_backend import (
+    RealELBv2API,
+    RealGlobalAcceleratorAPI,
+    RealRoute53API,
+)
+from agac_tpu.cloudprovider.aws.sigv4 import Credentials, sign_request
+from agac_tpu.cloudprovider.aws.types import (
+    AliasTarget,
+    Change,
+    EndpointConfiguration,
+    PortRange,
+    ResourceRecord,
+    ResourceRecordSet,
+    Tag,
+)
+
+CREDS = Credentials("AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY")
+
+
+class StubTransport:
+    def __init__(self):
+        self.requests = []
+        self.responses = []
+
+    def queue(self, status, body):
+        self.responses.append(
+            (status, body if isinstance(body, bytes) else json.dumps(body).encode())
+        )
+
+    def __call__(self, method, url, headers, body, timeout):
+        self.requests.append((method, url, headers, body))
+        return self.responses.pop(0)
+
+
+def test_sigv4_official_get_vanilla_vector():
+    """AWS's published 'get-vanilla' SigV4 test case."""
+    now = datetime.datetime(2015, 8, 30, 12, 36, 0, tzinfo=datetime.timezone.utc)
+    signed = sign_request(
+        "GET",
+        "https://example.amazonaws.com/",
+        {},
+        b"",
+        "service",
+        "us-east-1",
+        CREDS,
+        now=now,
+    )
+    assert signed["Authorization"] == (
+        "AWS4-HMAC-SHA256 "
+        "Credential=AKIDEXAMPLE/20150830/us-east-1/service/aws4_request, "
+        "SignedHeaders=host;x-amz-date, "
+        "Signature=5fa00fa31553b73ebf1942676e86291e8372ff2a2260956d9b8aae1d763fbf31"
+    )
+
+
+def test_sigv4_query_ordering_vector():
+    """AWS's 'get-vanilla-query-order-key-case' test case."""
+    now = datetime.datetime(2015, 8, 30, 12, 36, 0, tzinfo=datetime.timezone.utc)
+    signed = sign_request(
+        "GET",
+        "https://example.amazonaws.com/?Param2=value2&Param1=value1",
+        {},
+        b"",
+        "service",
+        "us-east-1",
+        CREDS,
+        now=now,
+    )
+    assert signed["Authorization"].endswith(
+        "Signature=b97d918cfa904a5beff61c982a1b6f458b799221646efd99d3219ec94cdf2500"
+    )
+
+
+def test_session_token_header_included():
+    creds = Credentials("AKID", "secret", session_token="tok123")
+    signed = sign_request(
+        "GET", "https://example.amazonaws.com/", {}, b"", "service", "us-east-1", creds
+    )
+    assert signed["X-Amz-Security-Token"] == "tok123"
+    assert "x-amz-security-token" in signed["Authorization"]
+
+
+class TestGlobalAcceleratorProtocol:
+    @pytest.fixture
+    def api(self):
+        stub = StubTransport()
+        return RealGlobalAcceleratorAPI(credentials=CREDS, transport=stub), stub
+
+    def test_list_accelerators_request_and_parse(self, api):
+        client, stub = api
+        stub.queue(
+            200,
+            {
+                "Accelerators": [
+                    {
+                        "AcceleratorArn": "arn:ga:1",
+                        "Name": "web",
+                        "DnsName": "abc.awsglobalaccelerator.com",
+                        "Enabled": True,
+                        "Status": "DEPLOYED",
+                    }
+                ],
+                "NextToken": "tok",
+            },
+        )
+        accelerators, token = client.list_accelerators(100, None)
+        method, url, headers, body = stub.requests[0]
+        assert method == "POST"
+        assert url == "https://globalaccelerator.us-west-2.amazonaws.com/"
+        assert headers["X-Amz-Target"] == "GlobalAccelerator_V20180706.ListAccelerators"
+        assert headers["Content-Type"] == "application/x-amz-json-1.1"
+        assert "Authorization" in headers
+        assert json.loads(body) == {"MaxResults": 100}
+        assert token == "tok"
+        assert accelerators[0].accelerator_arn == "arn:ga:1"
+        assert accelerators[0].status == "DEPLOYED"
+
+    def test_create_accelerator_payload(self, api):
+        client, stub = api
+        stub.queue(200, {"Accelerator": {"AcceleratorArn": "arn:new"}})
+        client.create_accelerator("name", "IPV4", True, [Tag("k", "v")])
+        payload = json.loads(stub.requests[0][3])
+        assert payload == {
+            "Name": "name",
+            "IpAddressType": "IPV4",
+            "Enabled": True,
+            "Tags": [{"Key": "k", "Value": "v"}],
+        }
+
+    def test_create_listener_port_ranges(self, api):
+        client, stub = api
+        stub.queue(
+            200,
+            {
+                "Listener": {
+                    "ListenerArn": "arn:l",
+                    "Protocol": "TCP",
+                    "PortRanges": [{"FromPort": 80, "ToPort": 80}],
+                }
+            },
+        )
+        listener = client.create_listener("arn:ga", [PortRange(80, 80)], "TCP", "NONE")
+        payload = json.loads(stub.requests[0][3])
+        assert payload["PortRanges"] == [{"FromPort": 80, "ToPort": 80}]
+        assert listener.port_ranges[0].from_port == 80
+
+    def test_weight_zero_is_serialized(self, api):
+        client, stub = api
+        stub.queue(200, {"EndpointGroup": {"EndpointGroupArn": "arn:eg"}})
+        client.update_endpoint_group(
+            "arn:eg", [EndpointConfiguration(endpoint_id="arn:lb", weight=0)]
+        )
+        payload = json.loads(stub.requests[0][3])
+        # weight 0 means "drain" in GA and must not be dropped
+        assert payload["EndpointConfigurations"][0]["Weight"] == 0
+
+    def test_error_code_mapping(self, api):
+        client, stub = api
+        stub.queue(
+            400,
+            {"__type": "com.amazon#EndpointGroupNotFoundException", "message": "gone"},
+        )
+        with pytest.raises(EndpointGroupNotFoundException):
+            client.describe_endpoint_group("arn:eg")
+        stub.queue(400, {"__type": "ListenerNotFoundException"})
+        with pytest.raises(ListenerNotFoundException):
+            client.list_listeners("arn:ga", 100, None)
+        stub.queue(400, {"__type": "AccessDeniedException", "message": "no"})
+        with pytest.raises(AWSAPIError) as exc:
+            client.describe_accelerator("arn:a")
+        assert exc.value.code == "AccessDeniedException"
+
+
+class TestELBv2Protocol:
+    def test_describe_load_balancers(self):
+        stub = StubTransport()
+        api = RealELBv2API("eu-west-1", credentials=CREDS, transport=stub)
+        stub.queue(
+            200,
+            b"""<?xml version="1.0"?>
+<DescribeLoadBalancersResponse xmlns="http://elasticloadbalancing.amazonaws.com/doc/2015-12-01/">
+  <DescribeLoadBalancersResult>
+    <LoadBalancers>
+      <member>
+        <LoadBalancerArn>arn:aws:elasticloadbalancing:eu-west-1:1:loadbalancer/net/web/1</LoadBalancerArn>
+        <LoadBalancerName>web</LoadBalancerName>
+        <DNSName>web-1.elb.eu-west-1.amazonaws.com</DNSName>
+        <State><Code>active</Code></State>
+        <Type>network</Type>
+        <Scheme>internet-facing</Scheme>
+      </member>
+    </LoadBalancers>
+  </DescribeLoadBalancersResult>
+</DescribeLoadBalancersResponse>""",
+        )
+        lbs = api.describe_load_balancers(["web"])
+        method, url, headers, body = stub.requests[0]
+        assert url == "https://elasticloadbalancing.eu-west-1.amazonaws.com/"
+        params = dict(urllib.parse.parse_qsl(body.decode()))
+        assert params["Action"] == "DescribeLoadBalancers"
+        assert params["Names.member.1"] == "web"
+        assert lbs[0].load_balancer_name == "web"
+        assert lbs[0].state_code == "active"
+
+    def test_xml_error_mapping(self):
+        stub = StubTransport()
+        api = RealELBv2API("eu-west-1", credentials=CREDS, transport=stub)
+        stub.queue(
+            400,
+            b"""<ErrorResponse xmlns="http://elasticloadbalancing.amazonaws.com/doc/2015-12-01/">
+  <Error><Type>Sender</Type><Code>LoadBalancerNotFound</Code><Message>nope</Message></Error>
+</ErrorResponse>""",
+        )
+        with pytest.raises(AWSAPIError) as exc:
+            api.describe_load_balancers(["missing"])
+        assert exc.value.code == "LoadBalancerNotFound"
+
+
+class TestRoute53Protocol:
+    @pytest.fixture
+    def api(self):
+        stub = StubTransport()
+        return RealRoute53API(credentials=CREDS, transport=stub), stub
+
+    def test_list_hosted_zones_by_name(self, api):
+        client, stub = api
+        stub.queue(
+            200,
+            b"""<ListHostedZonesByNameResponse xmlns="https://route53.amazonaws.com/doc/2013-04-01/">
+  <HostedZones><HostedZone><Id>/hostedzone/Z1</Id><Name>example.com.</Name></HostedZone></HostedZones>
+</ListHostedZonesByNameResponse>""",
+        )
+        zones = client.list_hosted_zones_by_name("example.com.", 1)
+        url = stub.requests[0][1]
+        assert "/2013-04-01/hostedzonesbyname?" in url
+        assert "dnsname=example.com." in url
+        assert zones[0].id == "/hostedzone/Z1"
+
+    def test_change_batch_xml(self, api):
+        client, stub = api
+        stub.queue(200, b"<ChangeResourceRecordSetsResponse/>")
+        client.change_resource_record_sets(
+            "/hostedzone/Z1",
+            [
+                Change(
+                    "CREATE",
+                    ResourceRecordSet(
+                        name="app.example.com",
+                        type="A",
+                        alias_target=AliasTarget(
+                            dns_name="abc.awsglobalaccelerator.com",
+                            evaluate_target_health=True,
+                            hosted_zone_id="Z2BJ6XQ5FK7U4H",
+                        ),
+                    ),
+                ),
+                Change(
+                    "CREATE",
+                    ResourceRecordSet(
+                        name="app.example.com",
+                        type="TXT",
+                        ttl=300,
+                        resource_records=[ResourceRecord('"heritage=..."')],
+                    ),
+                ),
+            ],
+        )
+        method, url, headers, body = stub.requests[0]
+        assert method == "POST"
+        assert url.endswith("/2013-04-01/hostedzone/Z1/rrset")
+        text = body.decode()
+        assert "<Action>CREATE</Action>" in text
+        assert "<HostedZoneId>Z2BJ6XQ5FK7U4H</HostedZoneId>" in text
+        assert "<TTL>300</TTL>" in text
+        assert '<Value>"heritage=..."</Value>' in text
+
+    def test_list_record_sets_pagination_flag(self, api):
+        client, stub = api
+        stub.queue(
+            200,
+            b"""<ListResourceRecordSetsResponse xmlns="https://route53.amazonaws.com/doc/2013-04-01/">
+  <ResourceRecordSets>
+    <ResourceRecordSet><Name>a.example.com.</Name><Type>A</Type>
+      <AliasTarget><HostedZoneId>Z2BJ6XQ5FK7U4H</HostedZoneId><DNSName>x.com.</DNSName><EvaluateTargetHealth>true</EvaluateTargetHealth></AliasTarget>
+    </ResourceRecordSet>
+  </ResourceRecordSets>
+  <IsTruncated>true</IsTruncated>
+  <NextRecordName>b.example.com.</NextRecordName>
+</ListResourceRecordSetsResponse>""",
+        )
+        records, next_name = client.list_resource_record_sets("/hostedzone/Z1", 300, None)
+        assert next_name == "b.example.com."
+        assert records[0].alias_target.dns_name == "x.com."
+        assert records[0].alias_target.evaluate_target_health is True
+
+    def test_route53_error(self, api):
+        client, stub = api
+        stub.queue(
+            404,
+            b"""<ErrorResponse xmlns="https://route53.amazonaws.com/doc/2013-04-01/">
+  <Error><Code>NoSuchHostedZone</Code><Message>gone</Message></Error>
+</ErrorResponse>""",
+        )
+        with pytest.raises(AWSAPIError) as exc:
+            client.list_hosted_zones(100, None)
+        assert exc.value.code == "NoSuchHostedZone"

@@ -1,0 +1,179 @@
+"""REST cluster client tests with a stub transport: path/verb/body
+construction, error mapping, watch-stream parsing, kubeconfig loading."""
+
+import json
+
+import pytest
+
+from agac_tpu.cluster import ObjectMeta, Service
+from agac_tpu.cluster.rest import (
+    ClusterAPIError,
+    RestClusterClient,
+    build_client_from_kubeconfig,
+)
+from agac_tpu.errors import AlreadyExistsError, ConflictError, NotFoundError
+
+
+class StubTransport:
+    def __init__(self):
+        self.requests = []
+        self.responses = []
+
+    def queue(self, status, body):
+        self.responses.append((status, body if isinstance(body, bytes) else json.dumps(body).encode()))
+
+    def __call__(self, method, url, headers, body, timeout, stream):
+        self.requests.append((method, url, headers, body))
+        status, payload = self.responses.pop(0)
+        if stream:
+            return status, iter(payload.splitlines(keepends=True))
+        return status, payload
+
+
+@pytest.fixture
+def stub():
+    return StubTransport()
+
+
+@pytest.fixture
+def client(stub):
+    return RestClusterClient("https://api.example:6443", token="tok", transport=stub)
+
+
+def test_get_builds_core_path_and_auth(client, stub):
+    stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
+    svc = client.get("Service", "default", "web")
+    method, url, headers, body = stub.requests[0]
+    assert method == "GET"
+    assert url == "https://api.example:6443/api/v1/namespaces/default/services/web"
+    assert headers["Authorization"] == "Bearer tok"
+    assert svc.metadata.name == "web"
+
+
+def test_crd_path(client, stub):
+    stub.queue(200, {"metadata": {"name": "b", "namespace": "ns"}})
+    client.get("EndpointGroupBinding", "ns", "b")
+    assert (
+        stub.requests[0][1]
+        == "https://api.example:6443/apis/operator.h3poteto.dev/v1alpha1/namespaces/ns/endpointgroupbindings/b"
+    )
+
+
+def test_list_returns_items_and_rv(client, stub):
+    stub.queue(
+        200,
+        {
+            "metadata": {"resourceVersion": "42"},
+            "items": [{"metadata": {"name": "a"}}, {"metadata": {"name": "b"}}],
+        },
+    )
+    items, rv = client.list("Service")
+    assert stub.requests[0][1].endswith("/api/v1/services")
+    assert rv == "42" and [i.metadata.name for i in items] == ["a", "b"]
+
+
+def test_create_posts_wire_body_with_type_meta(client, stub):
+    stub.queue(201, {"metadata": {"name": "web", "namespace": "default", "uid": "u1"}})
+    created = client.create(
+        "Service", Service(metadata=ObjectMeta(name="web", namespace="default"))
+    )
+    method, url, headers, body = stub.requests[0]
+    assert method == "POST"
+    assert url.endswith("/api/v1/namespaces/default/services")
+    payload = json.loads(body)
+    assert payload["apiVersion"] == "v1" and payload["kind"] == "Service"
+    assert created.metadata.uid == "u1"
+
+
+def test_update_status_subresource_path(client, stub):
+    stub.queue(200, {"metadata": {"name": "b", "namespace": "ns"}})
+    from agac_tpu.apis.endpointgroupbinding import EndpointGroupBinding
+
+    obj = EndpointGroupBinding(metadata=ObjectMeta(name="b", namespace="ns"))
+    client.update_status("EndpointGroupBinding", obj)
+    method, url, _, body = stub.requests[0]
+    assert method == "PUT"
+    assert url.endswith("/endpointgroupbindings/b/status")
+    assert json.loads(body)["apiVersion"] == "operator.h3poteto.dev/v1alpha1"
+
+
+def test_error_mapping(client, stub):
+    stub.queue(404, {"message": "not found"})
+    with pytest.raises(NotFoundError):
+        client.get("Service", "ns", "gone")
+    stub.queue(409, {"message": "object has been modified"})
+    with pytest.raises(ConflictError):
+        client.update("Service", Service(metadata=ObjectMeta(name="x", namespace="ns")))
+    stub.queue(409, {"message": 'services "x" already exists'})
+    with pytest.raises(AlreadyExistsError):
+        client.create("Service", Service(metadata=ObjectMeta(name="x", namespace="ns")))
+    stub.queue(500, {"message": "boom"})
+    with pytest.raises(ClusterAPIError):
+        client.get("Service", "ns", "x")
+
+
+def test_watch_parses_stream(client, stub):
+    lines = b"".join(
+        json.dumps(e).encode() + b"\n"
+        for e in [
+            {"type": "ADDED", "object": {"metadata": {"name": "a", "resourceVersion": "1"}}},
+            {"type": "BOOKMARK", "object": {"metadata": {"resourceVersion": "2"}}},
+            {"type": "MODIFIED", "object": {"metadata": {"name": "a", "resourceVersion": "3"}}},
+        ]
+    )
+    stub.queue(200, lines)
+    events = list(client.watch("Service", "0", lambda: False))
+    assert [(e.type, e.obj.metadata.name) for e in events] == [
+        ("ADDED", "a"),
+        ("MODIFIED", "a"),
+    ]
+    assert "watch=true" in stub.requests[0][1]
+
+
+def test_watch_stops_on_error_event(client, stub):
+    lines = json.dumps(
+        {"type": "ERROR", "object": {"code": 410, "reason": "Gone"}}
+    ).encode()
+    stub.queue(200, lines)
+    events = list(client.watch("Service", "5", lambda: False))
+    assert events == []
+
+
+def test_kubeconfig_token_auth(tmp_path):
+    config = {
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": "http://127.0.0.1:8080"}}],
+        "users": [{"name": "u", "user": {"token": "secret-token"}}],
+    }
+    import yaml
+
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(config))
+    client = build_client_from_kubeconfig(str(path))
+    assert client.base_url == "http://127.0.0.1:8080"
+    assert client._token == "secret-token"
+
+
+def test_kubeconfig_master_override(tmp_path):
+    config = {
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": "http://one:8080"}}],
+        "users": [{"name": "u", "user": {}}],
+    }
+    import yaml
+
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(config))
+    client = build_client_from_kubeconfig(str(path), master_url="http://two:8080")
+    assert client.base_url == "http://two:8080"
+
+
+def test_kubeconfig_missing_context_errors(tmp_path):
+    import yaml
+
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump({"contexts": []}))
+    with pytest.raises(ValueError, match="no context"):
+        build_client_from_kubeconfig(str(path))

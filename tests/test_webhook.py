@@ -1,0 +1,152 @@
+"""Webhook tests — the full HTTP round trip like the reference's
+``pkg/webhoook/webhook_test.go`` (allow on weight change, deny on ARN
+change, content-type and body validation), against a live server on an
+ephemeral port."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from agac_tpu.apis.endpointgroupbinding import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from agac_tpu.cluster import ObjectMeta
+from agac_tpu.cluster.serde import to_wire
+from agac_tpu.webhook import make_server, validate
+
+
+def binding_wire(arn="arn:aws:ga::123:eg/1", weight=None):
+    """The shared fixture-object builder (the ``pkg/fixture`` analog)."""
+    obj = EndpointGroupBinding(
+        metadata=ObjectMeta(name="test", namespace="default"),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn=arn,
+            weight=weight,
+            service_ref=ServiceReference(name="svc"),
+        ),
+    )
+    return to_wire(obj)
+
+
+def review(operation="UPDATE", old=None, new=None, kind="EndpointGroupBinding"):
+    request = {
+        "uid": "test-uid-1",
+        "kind": {"group": "operator.h3poteto.dev", "version": "v1alpha1", "kind": kind},
+        "operation": operation,
+    }
+    if old is not None:
+        request["oldObject"] = old
+    if new is not None:
+        request["object"] = new
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": request,
+    }
+
+
+class TestValidator:
+    def test_weight_change_allowed(self):
+        response = validate(
+            review(old=binding_wire(weight=50), new=binding_wire(weight=128))
+        )
+        assert response["response"]["allowed"] is True
+        assert response["response"]["status"]["message"] == "valid"
+        assert response["response"]["uid"] == "test-uid-1"
+
+    def test_arn_change_denied(self):
+        response = validate(
+            review(old=binding_wire(arn="arn:a"), new=binding_wire(arn="arn:b"))
+        )
+        assert response["response"]["allowed"] is False
+        assert response["response"]["status"]["code"] == 403
+        assert "immutable" in response["response"]["status"]["message"]
+
+    def test_create_allowed_without_old_object(self):
+        response = validate(review(operation="CREATE", new=binding_wire()))
+        assert response["response"]["allowed"] is True
+
+    def test_update_without_old_object_allowed(self):
+        response = validate(review(new=binding_wire()))
+        assert response["response"]["allowed"] is True
+
+    def test_wrong_kind_denied_400(self):
+        response = validate(review(kind="Service", old=binding_wire(), new=binding_wire()))
+        assert response["response"]["allowed"] is False
+        assert response["response"]["status"]["code"] == 400
+
+
+@pytest.fixture
+def server():
+    srv = make_server(0)  # ephemeral port
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def post(url, body, content_type="application/json"):
+    request = urllib.request.Request(
+        url,
+        data=body if isinstance(body, bytes) else json.dumps(body).encode(),
+        headers={"Content-Type": content_type},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=5) as response:
+            return response.status, response.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+class TestServer:
+    def test_healthz(self, server):
+        with urllib.request.urlopen(f"{server}/healthz", timeout=5) as response:
+            assert response.status == 200
+
+    def test_round_trip_deny(self, server):
+        status, body = post(
+            f"{server}/validate-endpointgroupbinding",
+            review(old=binding_wire(arn="arn:a"), new=binding_wire(arn="arn:b")),
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["response"]["allowed"] is False
+        assert payload["response"]["status"]["code"] == 403
+
+    def test_round_trip_allow(self, server):
+        status, body = post(
+            f"{server}/validate-endpointgroupbinding",
+            review(old=binding_wire(weight=1), new=binding_wire(weight=2)),
+        )
+        assert status == 200
+        assert json.loads(body)["response"]["allowed"] is True
+
+    def test_wrong_content_type_400(self, server):
+        status, body = post(
+            f"{server}/validate-endpointgroupbinding",
+            review(new=binding_wire()),
+            content_type="text/plain",
+        )
+        assert status == 400
+        assert b"invalid Content-Type" in body
+
+    def test_empty_body_400(self, server):
+        status, body = post(f"{server}/validate-endpointgroupbinding", b"")
+        assert status == 400
+        assert b"empty body" in body
+
+    def test_missing_request_400(self, server):
+        status, body = post(f"{server}/validate-endpointgroupbinding", {"kind": "AdmissionReview"})
+        assert status == 400
+        assert b"empty request" in body
+
+    def test_unknown_path_404(self, server):
+        status, _ = post(f"{server}/other", {"x": 1})
+        assert status == 404
