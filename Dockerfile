@@ -1,0 +1,15 @@
+# Container image, the analog of the reference's multi-stage
+# static-binary -> distroless build (reference Dockerfile:1-22).
+# Python equivalent: slim base, no build stage needed (pure stdlib
+# runtime deps besides pyyaml), non-root.
+
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY agac_tpu ./agac_tpu
+RUN pip install --no-cache-dir pyyaml && pip install --no-cache-dir .
+
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "agac_tpu"]
+CMD ["controller"]

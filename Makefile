@@ -1,0 +1,34 @@
+# Build/test/codegen targets, the analog of the reference's Makefile
+# (build/run/install/codegen/manifests, reference Makefile:19-52).
+
+PYTHON ?= python
+
+.PHONY: test
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+.PHONY: run
+run:
+	$(PYTHON) -m agac_tpu controller
+
+.PHONY: webhook
+webhook:
+	$(PYTHON) -m agac_tpu webhook --ssl=false --port 8080
+
+.PHONY: manifests
+manifests:
+	$(PYTHON) -m agac_tpu manifests -o config
+
+# CI drift check: regenerating manifests must leave the tree clean
+# (the analog of .github/workflows/manifests.yml)
+.PHONY: check-manifests
+check-manifests: manifests
+	git diff --exit-code config/
+
+.PHONY: bench
+bench:
+	$(PYTHON) bench.py
+
+.PHONY: image
+image:
+	docker build -t aws-global-accelerator-controller:latest .

@@ -36,7 +36,7 @@ from .errors import (
     EndpointGroupNotFoundException,
     ListenerNotFoundException,
 )
-from .sigv4 import Credentials, CredentialProvider, sign_request
+from .sigv4 import Credentials, CredentialProvider, sign_request, xml_strip_ns
 from .types import (
     Accelerator,
     AliasTarget,
@@ -378,16 +378,9 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
 # ---------------------------------------------------------------------------
 
 
-def _xml_strip_ns(root: ET.Element) -> ET.Element:
-    for element in root.iter():
-        if "}" in element.tag:
-            element.tag = element.tag.split("}", 1)[1]
-    return root
-
-
 def _xml_error(status: int, body: bytes) -> AWSAPIError:
     try:
-        root = _xml_strip_ns(ET.fromstring(body))
+        root = xml_strip_ns(ET.fromstring(body))
         code = root.findtext(".//Code") or "UnknownError"
         message = root.findtext(".//Message") or ""
         return AWSAPIError(code, message)
@@ -418,7 +411,7 @@ class RealELBv2API(ELBv2API):
         )
         if status >= 300:
             raise _xml_error(status, response)
-        root = _xml_strip_ns(ET.fromstring(response))
+        root = xml_strip_ns(ET.fromstring(response))
         out = []
         for member in root.findall(".//LoadBalancers/member"):
             out.append(
@@ -501,7 +494,7 @@ class RealRoute53API(Route53API):
         status, response = self._client.request("GET", path, {}, b"")
         if status >= 300:
             raise _xml_error(status, response)
-        return _xml_strip_ns(ET.fromstring(response))
+        return xml_strip_ns(ET.fromstring(response))
 
     @staticmethod
     def _zone_from_xml(element: ET.Element) -> HostedZone:

@@ -40,6 +40,15 @@ STS_ENDPOINT = "https://sts.amazonaws.com/"
 _EXPIRY_MARGIN = 300.0  # refresh 5 min before expiry
 
 
+def xml_strip_ns(root: ET.Element) -> ET.Element:
+    """Strip XML namespaces in place (AWS XML responses are easier to
+    navigate without them); shared with the real service clients."""
+    for element in root.iter():
+        if "}" in element.tag:
+            element.tag = element.tag.split("}", 1)[1]
+    return root
+
+
 def _assume_role_with_web_identity(
     role_arn: str, token_file: str, urlopen=urllib.request.urlopen
 ) -> Credentials:
@@ -66,10 +75,7 @@ def _assume_role_with_web_identity(
     )
     with urlopen(request, timeout=30) as response:
         payload = response.read()
-    root = ET.fromstring(payload)
-    for element in root.iter():
-        if "}" in element.tag:
-            element.tag = element.tag.split("}", 1)[1]
+    root = xml_strip_ns(ET.fromstring(payload))
     creds = root.find(".//Credentials")
     if creds is None:
         raise RuntimeError("STS AssumeRoleWithWebIdentity returned no credentials")
@@ -145,7 +151,18 @@ class CredentialProvider:
                 return cached
             if self._static is not None and self._static.expiration is None:
                 return self._static
-            self._cached = self._resolver()
+            try:
+                self._cached = self._resolver()
+            except Exception:
+                # transient resolver failure (e.g. STS unreachable):
+                # keep serving cached credentials while they are still
+                # actually valid — refresh margin is an optimization,
+                # not a validity boundary
+                if cached is not None and (
+                    cached.expiration is None or cached.expiration > self._clock()
+                ):
+                    return cached
+                raise
             return self._cached
 
 

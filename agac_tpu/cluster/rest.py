@@ -105,17 +105,11 @@ class RestClusterClient(ClusterClient):
         except urllib.error.HTTPError as err:
             return err.code, err.read()
         if stream:
-            return response.status, self._line_iter(response)
+            # file-like: the watch loop reads lines itself so it can
+            # poll stop() on idle-read timeouts
+            return response.status, response
         with response:
             return response.status, response.read()
-
-    @staticmethod
-    def _line_iter(response) -> Iterator[bytes]:
-        try:
-            for line in response:
-                yield line
-        finally:
-            response.close()
 
     def _request(
         self, method: str, path: str, body: Optional[dict] = None, timeout: float = 30.0, stream: bool = False
@@ -207,6 +201,14 @@ class RestClusterClient(ClusterClient):
         if status >= 300:
             _raise_for_status(status, body, f"delete {kind} {namespace}/{name}")
 
+    # watch stream tuning: the server closes the stream politely after
+    # WATCH_SERVER_TIMEOUT (a clean relist boundary); the short socket
+    # timeout is only a stop()-polling interval — an idle read timeout
+    # resumes the same stream, so quiet clusters do NOT trigger
+    # relist/resync storms.
+    WATCH_SERVER_TIMEOUT = 240
+    WATCH_POLL_INTERVAL = 5.0
+
     def watch(
         self, kind: str, resource_version: str, stop: Callable[[], bool]
     ) -> Iterator[WatchEvent]:
@@ -215,21 +217,38 @@ class RestClusterClient(ClusterClient):
         errors, non-2xx — RAISE so the informer's error path applies
         its backoff instead of relisting in a tight loop."""
         query = urllib.parse.urlencode(
-            {"watch": "true", "resourceVersion": resource_version or "0"}
+            {
+                "watch": "true",
+                "resourceVersion": resource_version or "0",
+                "timeoutSeconds": str(self.WATCH_SERVER_TIMEOUT),
+            }
         )
         path = f"{self._collection_path(kind, None)}?{query}"
-        status, lines = self._request("GET", path, timeout=30.0, stream=True)
+        status, stream = self._request(
+            "GET", path, timeout=self.WATCH_POLL_INTERVAL, stream=True
+        )
         if status >= 300:
             raise ClusterAPIError(status, f"watch {kind}")
         try:
-            for line in lines:
-                if stop():
-                    return
+            while not stop():
+                try:
+                    line = stream.readline()
+                except socket.timeout:
+                    continue  # idle: poll stop() and keep the stream
+                except (TimeoutError, ssl.SSLError) as err:
+                    if "timed out" in str(err).lower():
+                        continue
+                    raise
+                if not line:
+                    return  # server closed; informer relists
                 if not line.strip():
                     continue
                 try:
                     payload = json.loads(line)
                 except ValueError:
+                    # a line truncated by a mid-read timeout parses as
+                    # garbage; skipping is safe — the next relist
+                    # (level trigger) recovers any lost event
                     continue
                 event_type = payload.get("type", "")
                 if event_type == "BOOKMARK":
@@ -241,9 +260,13 @@ class RestClusterClient(ClusterClient):
                     return
                 obj = self._decode(kind, payload.get("object") or {})
                 yield WatchEvent(event_type, obj)
-        except (socket.timeout, urllib.error.URLError, ConnectionError, OSError) as err:
+        except (urllib.error.URLError, ConnectionError, OSError) as err:
             klog.v(4).infof("watch %s: stream ended: %s", kind, err)
-        # stream closed; informer relists and re-watches
+        finally:
+            try:
+                stream.close()
+            except Exception:
+                pass
 
 
 # ---------------------------------------------------------------------------

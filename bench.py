@@ -1,0 +1,147 @@
+"""End-to-end reconcile-throughput benchmark.
+
+The reference publishes no benchmark numbers (BASELINE.md: no
+``benchmarks/`` dir, no ``Benchmark*`` funcs, no perf claims), so this
+measures the framework's own headline capability — full watch →
+informer → queue → reconcile → cloud-ensure convergence — and reports
+``vs_baseline`` against the reference's implicit operating point: its
+default configuration processes items with 1 worker per queue
+(``cmd/controller/controller.go:32``) and is bounded by serial AWS
+round trips per reconcile (the N+1 ListTags scan,
+``global_accelerator.go:87-110``); with its in-code timings a single
+item converges in one reconcile pass, so the baseline proxy here is
+this framework run with workers=1 — vs_baseline = throughput(workers=N)
+/ throughput(workers=1) shows the concurrency headroom the rebuild
+adds on identical fake-cloud latency.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import threading
+import time
+
+from agac_tpu import klog
+from agac_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+)
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cluster import FakeCluster, LoadBalancerIngress, ObjectMeta, Service, ServicePort
+from agac_tpu.cluster.objects import ServiceSpec
+from agac_tpu.manager import ControllerConfig, Manager
+from agac_tpu.controllers import (
+    EndpointGroupBindingConfig,
+    GlobalAcceleratorConfig,
+    Route53Config,
+)
+
+N_SERVICES = 150
+SIMULATED_AWS_LATENCY = 0.002  # 2 ms per AWS call, applied uniformly
+
+
+class LatencyAWS(FakeAWSBackend):
+    """Fake AWS with a uniform simulated per-call latency so the
+    benchmark exercises IO-bound concurrency, not pure Python speed."""
+
+    def __getattribute__(self, name):
+        attr = super().__getattribute__(name)
+        if name in (
+            "list_accelerators",
+            "list_tags_for_resource",
+            "describe_load_balancers",
+            "create_accelerator",
+            "create_listener",
+            "create_endpoint_group",
+            "list_listeners",
+            "list_endpoint_groups",
+        ):
+            def timed(*args, **kwargs):
+                time.sleep(SIMULATED_AWS_LATENCY)
+                return attr(*args, **kwargs)
+
+            return timed
+        return attr
+
+
+def make_service(i: int) -> Service:
+    hostname = f"bench{i:04d}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+    svc = Service(
+        metadata=ObjectMeta(
+            name=f"bench{i:04d}",
+            namespace=f"ns{i % 10}",
+            annotations={
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+            },
+        ),
+        spec=ServiceSpec(
+            type="LoadBalancer", ports=[ServicePort(name="http", port=80, protocol="TCP")]
+        ),
+    )
+    svc.status.load_balancer.ingress.append(LoadBalancerIngress(hostname=hostname))
+    return svc
+
+
+def run_convergence(workers: int) -> float:
+    """Create N_SERVICES annotated services, return services/sec until
+    every accelerator chain exists."""
+    cluster = FakeCluster()
+    aws = LatencyAWS()
+    for i in range(N_SERVICES):
+        aws.add_load_balancer(
+            f"bench{i:04d}",
+            "us-west-2",
+            f"bench{i:04d}-0123456789abcdef.elb.us-west-2.amazonaws.com",
+        )
+    stop = threading.Event()
+    config = ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(workers=workers),
+        route53=Route53Config(workers=workers),
+        endpoint_group_binding=EndpointGroupBindingConfig(workers=workers),
+    )
+    manager = Manager(resync_period=300)
+    manager.run(
+        cluster,
+        config,
+        stop,
+        cloud_factory=lambda region: AWSDriver(aws, aws, aws),
+        block=False,
+    )
+    for i in range(N_SERVICES):
+        cluster.create("Service", make_service(i))
+    start = time.monotonic()
+    deadline = start + 300
+    while time.monotonic() < deadline:
+        if len(aws.all_accelerator_arns()) >= N_SERVICES:
+            break
+        time.sleep(0.01)
+    elapsed = time.monotonic() - start
+    stop.set()
+    done = len(aws.all_accelerator_arns())
+    if done < N_SERVICES:
+        raise SystemExit(f"benchmark did not converge: {done}/{N_SERVICES}")
+    return N_SERVICES / elapsed
+
+
+def main():
+    klog.init(verbosity=-1)
+    import logging
+
+    logging.getLogger("agac").setLevel(logging.CRITICAL)
+    baseline = run_convergence(workers=1)  # the reference's default operating point
+    value = run_convergence(workers=8)
+    print(
+        json.dumps(
+            {
+                "metric": "service_to_accelerator_convergence_throughput",
+                "value": round(value, 2),
+                "unit": "services/sec",
+                "vs_baseline": round(value / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

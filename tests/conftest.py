@@ -1,10 +1,16 @@
 """Test harness configuration.
 
-Multi-chip sharding anywhere in the test suite runs on a virtual
-8-device CPU mesh, per the driver contract; the core controller
-framework itself has no JAX dependency (the reference is a Go
-Kubernetes controller with no tensor workload — SURVEY.md preamble).
-These env vars must be set before jax is first imported anywhere.
+The core controller framework has no JAX dependency (the reference is
+a Go Kubernetes controller with no tensor workload — SURVEY.md
+preamble); only the driver-contract shim ``__graft_entry__.py`` uses
+JAX, and its test runs in a subprocess.
+
+Note for this image: the axon TPU plugin is pre-imported via a .pth
+hook and overrides ``JAX_PLATFORMS``, so env vars alone cannot select
+a virtual CPU mesh — ``jax.config.update('jax_platforms', 'cpu')`` +
+``jax.config.update('jax_num_cpu_devices', N)`` before first backend
+use is the working mechanism (done inside ``__graft_entry__``).  The
+env vars below are kept for environments with a stock jax.
 """
 
 import os

@@ -124,3 +124,24 @@ class TestCredentialProvider:
         assert provider.get().access_key_id == "FIRST"  # cached
         now[0] = 1800.0  # within 5-min margin of the 2000.0 expiry
         assert provider.get().access_key_id == "SECOND"
+
+
+def test_provider_serves_cached_when_refresh_fails_within_margin():
+    now = [1000.0]
+    calls = []
+
+    def resolver():
+        calls.append(1)
+        if len(calls) == 1:
+            return Credentials("FIRST", "s", expiration=2000.0)
+        raise RuntimeError("STS unreachable")
+
+    provider = CredentialProvider(resolver=resolver, clock=lambda: now[0])
+    assert provider.get().access_key_id == "FIRST"
+    now[0] = 1800.0  # inside 5-min margin, creds still valid until 2000
+    assert provider.get().access_key_id == "FIRST"  # fallback to cache
+    now[0] = 2100.0  # actually expired: failure must propagate
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="STS unreachable"):
+        provider.get()

@@ -1,0 +1,23 @@
+"""Driver-contract smoke test: __graft_entry__ must compile single-chip
+and dry-run the multi-chip sharding on a virtual 8-device CPU mesh.
+Run in a subprocess because platform selection must happen before the
+first backend initialization."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_graft_entry_self_test():
+    result = subprocess.run(
+        [sys.executable, "__graft_entry__.py"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=300,
+    )
+    assert result.returncode == 0, result.stderr
+    assert "dryrun_multichip OK: mesh=(4 data x 2 model)" in result.stdout
+    assert "entry() forward: (32, 64)" in result.stdout

@@ -26,7 +26,9 @@ class StubTransport:
         self.requests.append((method, url, headers, body))
         status, payload = self.responses.pop(0)
         if stream:
-            return status, iter(payload.splitlines(keepends=True))
+            import io
+
+            return status, io.BytesIO(payload)  # file-like, has readline
         return status, payload
 
 
@@ -177,3 +179,44 @@ def test_kubeconfig_missing_context_errors(tmp_path):
     path.write_text(yaml.safe_dump({"contexts": []}))
     with pytest.raises(ValueError, match="no context"):
         build_client_from_kubeconfig(str(path))
+
+
+def test_watch_resumes_after_idle_timeout(client, stub):
+    """An idle socket timeout must poll stop() and keep the SAME
+    stream — not end it (which would trigger a relist storm)."""
+    import socket as socket_mod
+
+    class TimeoutThenLines:
+        def __init__(self):
+            self.calls = 0
+
+        def readline(self):
+            self.calls += 1
+            if self.calls == 1:
+                raise socket_mod.timeout("read timed out")
+            if self.calls == 2:
+                return json.dumps(
+                    {"type": "ADDED", "object": {"metadata": {"name": "late"}}}
+                ).encode() + b"\n"
+            return b""  # stream closed
+
+        def close(self):
+            pass
+
+    stream = TimeoutThenLines()
+    stub.responses.append((200, None))
+    original_call = stub.__call__
+
+    def transport(method, url, headers, body, timeout, stream_flag):
+        stub.requests.append((method, url, headers, body))
+        return 200, stream
+    client._transport = transport
+    events = list(client.watch("Service", "0", lambda: False))
+    assert [(e.type, e.obj.metadata.name) for e in events] == [("ADDED", "late")]
+    assert stream.calls == 3  # timeout, line, EOF — one stream throughout
+
+
+def test_watch_url_has_server_timeout(client, stub):
+    stub.queue(200, b"")
+    list(client.watch("Service", "0", lambda: False))
+    assert "timeoutSeconds=240" in stub.requests[0][1]
