@@ -6,8 +6,14 @@ endpoint region (us-west-2, reference ``aws.go:26-32``).
 
 ``AGAC_CLOUD=fake`` switches the whole process onto one shared
 in-memory backend — the no-credentials demo/e2e mode (the reference
-has no equivalent; its e2e needs real AWS).  The default mode builds
-the real SigV4 HTTP backend.
+has no equivalent; its e2e needs real AWS).  The fake can be seeded
+from the environment so annotated Services find their load balancers:
+
+- ``AGAC_FAKE_LBS``: comma-separated ``name=hostname`` pairs (region
+  is parsed from the hostname);
+- ``AGAC_FAKE_ZONES``: comma-separated hosted-zone names.
+
+The default mode builds the real SigV4 HTTP backend.
 """
 
 from __future__ import annotations
@@ -17,9 +23,29 @@ import threading
 
 from .driver import AWSDriver
 from .fake_backend import FakeAWSBackend
+from .load_balancer import get_lb_name_from_hostname
 
 _fake_backend: FakeAWSBackend | None = None
 _lock = threading.Lock()
+
+
+def _seed_from_environment(backend: FakeAWSBackend) -> None:
+    from ... import klog
+
+    for pair in filter(None, os.environ.get("AGAC_FAKE_LBS", "").split(",")):
+        name, _, hostname = pair.partition("=")
+        if not hostname:
+            continue
+        try:
+            _, region = get_lb_name_from_hostname(hostname)
+        except ValueError as err:
+            # a malformed entry must not poison every reconcile or
+            # leave the backend half-seeded
+            klog.errorf("AGAC_FAKE_LBS: skipping %r: %s", pair, err)
+            continue
+        backend.add_load_balancer(name, region, hostname)
+    for zone in filter(None, os.environ.get("AGAC_FAKE_ZONES", "").split(",")):
+        backend.add_hosted_zone(zone)
 
 
 def shared_fake_backend() -> FakeAWSBackend:
@@ -27,6 +53,7 @@ def shared_fake_backend() -> FakeAWSBackend:
     with _lock:
         if _fake_backend is None:
             _fake_backend = FakeAWSBackend()
+            _seed_from_environment(_fake_backend)
         return _fake_backend
 
 

@@ -140,6 +140,7 @@ class CredentialProvider:
         self._clock = clock or _time.time
         self._cached: Optional[Credentials] = static
         self._lock = threading.Lock()
+        self._resolve_cooldown_until = 0.0
 
     def get(self) -> Credentials:
         with self._lock:
@@ -151,16 +152,26 @@ class CredentialProvider:
                 return cached
             if self._static is not None and self._static.expiration is None:
                 return self._static
+            def cached_still_valid() -> bool:
+                return cached is not None and (
+                    cached.expiration is None or cached.expiration > self._clock()
+                )
+
+            # after a resolver failure, don't retry on every call —
+            # each attempt can block tens of seconds under this lock;
+            # serve the still-valid cache during the cooldown
+            if self._clock() < self._resolve_cooldown_until and cached_still_valid():
+                return cached
             try:
                 self._cached = self._resolver()
+                self._resolve_cooldown_until = 0.0
             except Exception:
                 # transient resolver failure (e.g. STS unreachable):
                 # keep serving cached credentials while they are still
                 # actually valid — refresh margin is an optimization,
                 # not a validity boundary
-                if cached is not None and (
-                    cached.expiration is None or cached.expiration > self._clock()
-                ):
+                self._resolve_cooldown_until = self._clock() + 30.0
+                if cached_still_valid():
                     return cached
                 raise
             return self._cached

@@ -1,0 +1,180 @@
+"""envtest-style e2e: the REST client, informers, leader election and
+the full controller stack running against the embedded HTTP apiserver
+— the analog of the reference's kind-cluster tier (SURVEY.md §4 tier
+2) plus its real-AWS full-loop structure (tier 3), with the fake AWS
+backend as the cloud."""
+
+import threading
+import time
+
+import pytest
+
+from agac_tpu import apis
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cluster import FakeCluster
+from agac_tpu.cluster.rest import RestClusterClient
+from agac_tpu.cluster.testserver import TestApiServer
+from agac_tpu.errors import ConflictError, NotFoundError
+from agac_tpu.leaderelection import LeaderElection, LeaderElectionConfig
+from agac_tpu.manager import ControllerConfig, Manager
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+
+
+def wait_until(pred, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture
+def server():
+    with TestApiServer() as srv:
+        yield srv
+
+
+@pytest.fixture
+def client(server):
+    return RestClusterClient(server.url)
+
+
+class TestRestAgainstHTTP:
+    def test_crud_round_trip(self, server, client):
+        created = client.create("Service", make_lb_service())
+        assert created.metadata.uid
+        fetched = client.get("Service", "default", "web")
+        assert fetched.spec.type == "LoadBalancer"
+        assert fetched.status.load_balancer.ingress[0].hostname == NLB_HOSTNAME
+
+        fetched.metadata.annotations["extra"] = "x"
+        updated = client.update("Service", fetched)
+        assert updated.metadata.annotations["extra"] == "x"
+
+        items, rv = client.list("Service")
+        assert len(items) == 1 and int(rv) >= 2
+
+        client.delete("Service", "default", "web")
+        with pytest.raises(NotFoundError):
+            client.get("Service", "default", "web")
+
+    def test_conflict_over_http(self, server, client):
+        client.create("Service", make_lb_service())
+        stale = client.get("Service", "default", "web")
+        fresh = client.get("Service", "default", "web")
+        client.update("Service", fresh)
+        with pytest.raises(ConflictError):
+            client.update("Service", stale)
+
+    def test_watch_streams_over_http(self, server, client):
+        events = []
+        done = threading.Event()
+
+        def consume():
+            for event in client.watch("Service", "0", lambda: done.is_set()):
+                events.append((event.type, event.obj.metadata.name))
+                if len(events) >= 2:
+                    break
+            done.set()
+
+        thread = threading.Thread(target=consume, daemon=True)
+        thread.start()
+        time.sleep(0.2)
+        client.create("Service", make_lb_service(name="w1"))
+        client.create("Service", make_lb_service(name="w2"))
+        assert done.wait(10)
+        assert events == [("ADDED", "w1"), ("ADDED", "w2")]
+
+    def test_status_subresource_over_http(self, server, client):
+        from agac_tpu.apis.endpointgroupbinding import (
+            EndpointGroupBinding,
+            EndpointGroupBindingSpec,
+            ServiceReference,
+        )
+        from agac_tpu.cluster import ObjectMeta
+
+        client.create(
+            "EndpointGroupBinding",
+            EndpointGroupBinding(
+                metadata=ObjectMeta(name="b", namespace="default"),
+                spec=EndpointGroupBindingSpec(
+                    endpoint_group_arn="arn:eg", service_ref=ServiceReference("svc")
+                ),
+            ),
+        )
+        obj = client.get("EndpointGroupBinding", "default", "b")
+        obj.status.endpoint_ids = ["arn:lb"]
+        updated = client.update_status("EndpointGroupBinding", obj)
+        assert updated.status.endpoint_ids == ["arn:lb"]
+        # spec untouched via status endpoint
+        assert updated.spec.endpoint_group_arn == "arn:eg"
+
+
+class TestLeaderElectionOverHTTP:
+    def test_lease_acquired_through_apiserver(self, server, client):
+        stop = threading.Event()
+        election = LeaderElection(
+            "agac-test", "default",
+            LeaderElectionConfig(lease_duration=1, renew_deadline=0.5, retry_period=0.05),
+        )
+        ran = threading.Event()
+
+        def run_fn(stop_event):
+            ran.set()
+            stop_event.wait()
+
+        thread = threading.Thread(
+            target=election.run, args=(client, run_fn, stop), daemon=True
+        )
+        thread.start()
+        assert ran.wait(10)
+        lease = client.get("Lease", "default", "agac-test")
+        assert lease.spec.holder_identity == election.identity
+        stop.set()
+        thread.join(5)
+        # released on clean shutdown
+        lease = client.get("Lease", "default", "agac-test")
+        assert lease.spec.holder_identity is None
+
+
+class TestFullStackOverHTTP:
+    def test_controllers_converge_through_real_http(self, server, client):
+        aws = FakeAWSBackend()
+        aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        zone = aws.add_hosted_zone("example.com")
+        stop = threading.Event()
+        try:
+            Manager(resync_period=1.0).run(
+                client,
+                ControllerConfig(),
+                stop,
+                cloud_factory=lambda region: AWSDriver(
+                    aws, aws, aws,
+                    poll_interval=0.01, poll_timeout=2.0,
+                    lb_not_active_retry=0.1, accelerator_missing_retry=0.1,
+                ),
+                block=False,
+            )
+            client.create(
+                "Service",
+                make_lb_service(
+                    annotations={apis.ROUTE53_HOSTNAME_ANNOTATION: "app.example.com"}
+                ),
+            )
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+            assert wait_until(
+                lambda: {(r.type) for r in aws.records_in_zone(zone.id)} == {"A", "TXT"}
+            )
+            # events visible through the apiserver
+            assert wait_until(
+                lambda: {
+                    e.reason for e in client.list("Event")[0]
+                } >= {"GlobalAcceleratorCreated", "Route53RecordCreated"}
+            )
+            client.delete("Service", "default", "web")
+            assert wait_until(lambda: aws.all_accelerator_arns() == [])
+            assert wait_until(lambda: aws.records_in_zone(zone.id) == [])
+        finally:
+            stop.set()

@@ -204,8 +204,6 @@ def test_watch_resumes_after_idle_timeout(client, stub):
             pass
 
     stream = TimeoutThenLines()
-    stub.responses.append((200, None))
-    original_call = stub.__call__
 
     def transport(method, url, headers, body, timeout, stream_flag):
         stub.requests.append((method, url, headers, body))
