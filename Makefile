@@ -20,10 +20,11 @@ manifests:
 	$(PYTHON) -m agac_tpu manifests -o config
 
 # CI drift check: regenerating manifests must leave the tree clean
-# (the analog of .github/workflows/manifests.yml)
+# (the analog of .github/workflows/manifests.yml); porcelain catches
+# untracked/removed generated files too
 .PHONY: check-manifests
 check-manifests: manifests
-	git diff --exit-code config/
+	@test -z "$$(git status --porcelain config/)" || { git status config/; exit 1; }
 
 .PHONY: bench
 bench:

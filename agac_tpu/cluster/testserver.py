@@ -20,6 +20,12 @@ control plane.  Endpoints implemented (for every kind in
 Errors are k8s ``Status`` JSON with the proper HTTP codes so the REST
 client's error mapping round-trips (404 NotFound, 409 Conflict /
 AlreadyExists).
+
+Validating admission webhooks can be registered per kind
+(``register_validating_webhook``): CREATE/UPDATE requests are wrapped
+in an AdmissionReview, POSTed to the webhook URL, and rejected with
+403 when not allowed — the flow the reference's kind e2e exercises
+against the real apiserver (``e2e/e2e_test.go:78-98``).
 """
 
 from __future__ import annotations
@@ -39,6 +45,15 @@ _PATH_TO_KIND = {
     (prefix, plural): kind
     for kind, (prefix, plural, _, _) in KIND_REGISTRY.items()
 }
+
+
+def _full_wire(kind: str, obj) -> dict:
+    """Wire envelope: serde dict stamped with apiVersion + kind."""
+    _, _, _, api_version = KIND_REGISTRY[kind]
+    wire = to_wire(obj)
+    wire["apiVersion"] = api_version
+    wire["kind"] = kind
+    return wire
 
 
 def _status_body(code: int, reason: str, message: str) -> bytes:
@@ -111,11 +126,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
 
     def _send_obj(self, code: int, kind: str, obj) -> None:
-        _, _, _, api_version = KIND_REGISTRY[kind]
-        wire = to_wire(obj)
-        wire["apiVersion"] = api_version
-        wire["kind"] = kind
-        self._send(code, json.dumps(wire).encode())
+        self._send(code, json.dumps(_full_wire(kind, obj)).encode())
 
     def _send_error_status(self, err: Exception, context: str) -> None:
         if isinstance(err, NotFoundError):
@@ -132,6 +143,49 @@ class _Handler(BaseHTTPRequestHandler):
         payload = json.loads(self.rfile.read(length)) if length else {}
         _, _, cls, _ = KIND_REGISTRY[kind]
         return from_wire(cls, payload)
+
+    def _admit(self, kind: str, operation: str, obj, old_obj) -> str | None:
+        """Run registered validating webhooks; returns a denial message
+        or None if allowed (failurePolicy=Fail semantics: webhook
+        errors reject the request, like the reference's configuration,
+        ``config/webhook/manifests.yaml`` failurePolicy: Fail)."""
+        webhook_url = self.server.webhooks.get(kind)  # type: ignore[attr-defined]
+        if webhook_url is None:
+            return None
+        import urllib.request
+        import uuid
+
+        def wrap(o):
+            return None if o is None else _full_wire(kind, o)
+
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": str(uuid.uuid4()),
+                "kind": {"kind": kind},
+                "operation": operation,
+                "object": wrap(obj),
+                "oldObject": wrap(old_obj),
+            },
+        }
+        request = urllib.request.Request(
+            webhook_url,
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(request, timeout=10) as response:
+                result = json.loads(response.read())
+        except Exception as err:
+            return f"admission webhook call failed: {err}"
+        resp = result.get("response") or {}
+        if resp.get("allowed"):
+            return None
+        # or-fallback, not get-default: an explicit null message must
+        # still read as a denial
+        return (resp.get("status") or {}).get("message") or "denied by admission webhook"
 
     # ------------------------------------------------------------------
     def do_GET(self):
@@ -154,12 +208,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         objs, rv = self.cluster.list(route.kind, route.namespace or None)
         _, _, _, api_version = KIND_REGISTRY[route.kind]
-        items = []
-        for obj in objs:
-            wire = to_wire(obj)
-            wire["apiVersion"] = api_version
-            wire["kind"] = route.kind
-            items.append(wire)
+        items = [_full_wire(route.kind, obj) for obj in objs]
         body = json.dumps(
             {
                 "apiVersion": api_version,
@@ -181,13 +230,14 @@ class _Handler(BaseHTTPRequestHandler):
             return stopped.is_set() or time.monotonic() >= deadline
 
         self._send(200, b"", chunked=True)
-        _, _, _, api_version = KIND_REGISTRY[kind]
         try:
             for event in self.cluster.watch(kind, query.get("resourceVersion", "0"), stop):
-                wire = to_wire(event.obj)
-                wire["apiVersion"] = api_version
-                wire["kind"] = kind
-                line = json.dumps({"type": event.type, "object": wire}).encode() + b"\n"
+                line = (
+                    json.dumps(
+                        {"type": event.type, "object": _full_wire(kind, event.obj)}
+                    ).encode()
+                    + b"\n"
+                )
                 self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
@@ -205,6 +255,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             obj = self._read_object(route.kind)
+            denial = self._admit(route.kind, "CREATE", obj, None)
+            if denial is not None:
+                self._send(403, _status_body(403, "Forbidden", denial))
+                return
             created = self.cluster.create(route.kind, obj)
         except Exception as err:
             self._send_error_status(err, route.kind)
@@ -221,6 +275,15 @@ class _Handler(BaseHTTPRequestHandler):
             if route.subresource == "status":
                 updated = self.cluster.update_status(route.kind, obj)
             else:
+                old_obj = None
+                try:
+                    old_obj = self.cluster.get(route.kind, route.namespace, route.name)
+                except NotFoundError:
+                    pass
+                denial = self._admit(route.kind, "UPDATE", obj, old_obj)
+                if denial is not None:
+                    self._send(403, _status_body(403, "Forbidden", denial))
+                    return
                 updated = self.cluster.update(route.kind, obj)
         except Exception as err:
             self._send_error_status(err, f"{route.kind} {route.name}")
@@ -251,8 +314,14 @@ class TestApiServer:
         self.cluster = cluster or FakeCluster()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.cluster = self.cluster  # type: ignore[attr-defined]
+        self._httpd.webhooks = {}  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
+
+    def register_validating_webhook(self, kind: str, url: str) -> None:
+        """Route CREATE/UPDATE admission for ``kind`` through the
+        webhook at ``url`` (the ValidatingWebhookConfiguration analog)."""
+        self._httpd.webhooks[kind] = url  # type: ignore[attr-defined]
 
     @property
     def url(self) -> str:

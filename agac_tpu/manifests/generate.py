@@ -160,6 +160,37 @@ def rbac_manifest() -> dict:
     }
 
 
+def service_account_manifest(namespace: str = "kube-system") -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {
+            "name": "aws-global-accelerator-controller",
+            "namespace": namespace,
+        },
+    }
+
+
+def cluster_role_binding_manifest(namespace: str = "kube-system") -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": "global-accelerator-manager-rolebinding"},
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": "global-accelerator-manager-role",
+        },
+        "subjects": [
+            {
+                "kind": "ServiceAccount",
+                "name": "aws-global-accelerator-controller",
+                "namespace": namespace,
+            }
+        ],
+    }
+
+
 def sample_manifests() -> dict[str, dict]:
     """Sample objects, the analog of ``config/samples/``."""
     return {
@@ -218,6 +249,36 @@ def sample_manifests() -> dict[str, dict]:
                 ],
             },
         },
+        "deployment.yaml": {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "aws-global-accelerator-controller", "namespace": "kube-system"},
+            "spec": {
+                "replicas": 2,  # leader election makes this active/standby
+                "selector": {"matchLabels": {"app": "aws-global-accelerator-controller"}},
+                "template": {
+                    "metadata": {"labels": {"app": "aws-global-accelerator-controller"}},
+                    "spec": {
+                        "serviceAccountName": "aws-global-accelerator-controller",
+                        "containers": [
+                            {
+                                "name": "controller",
+                                "image": "aws-global-accelerator-controller:latest",
+                                "args": ["-v", "2", "controller", "--cluster-name", "default"],
+                                "env": [
+                                    {
+                                        "name": "POD_NAMESPACE",
+                                        "valueFrom": {
+                                            "fieldRef": {"fieldPath": "metadata.namespace"}
+                                        },
+                                    }
+                                ],
+                            }
+                        ],
+                    },
+                },
+            },
+        },
         "endpointgroupbinding.yaml": {
             "apiVersion": f"{GROUP}/{VERSION}",
             "kind": KIND,
@@ -246,6 +307,8 @@ def write_manifests(directory: str) -> list[str]:
     emit(f"crd/{GROUP}_{PLURAL}.yaml", crd_manifest())
     emit("webhook/manifests.yaml", validating_webhook_manifest())
     emit("rbac/role.yaml", rbac_manifest())
+    emit("rbac/service_account.yaml", service_account_manifest())
+    emit("rbac/role_binding.yaml", cluster_role_binding_manifest())
     for name, doc in sample_manifests().items():
         emit(f"samples/{name}", doc)
     return written

@@ -302,6 +302,39 @@ class TestEndpointGroupBindingPath:
         described = harness.aws.describe_endpoint_group(endpoint_group.endpoint_group_arn)
         assert bound_id not in [d.endpoint_id for d in described.endpoint_descriptions]
 
+    def test_ingress_ref_binding(self, harness):
+        from agac_tpu.apis.endpointgroupbinding import (
+            EndpointGroupBindingSpec,
+            IngressReference,
+        )
+
+        endpoint_group = self.setup_endpoint_group(harness)
+        harness.cluster.create("Ingress", make_alb_ingress(name="bound-ing"))
+        binding = EndpointGroupBinding(
+            metadata=ObjectMeta(name="binding", namespace="default"),
+            spec=EndpointGroupBindingSpec(
+                endpoint_group_arn=endpoint_group.endpoint_group_arn,
+                weight=33,
+                ingress_ref=IngressReference(name="bound-ing"),
+            ),
+        )
+        harness.cluster.create("EndpointGroupBinding", binding)
+
+        def bound():
+            try:
+                obj = harness.cluster.get("EndpointGroupBinding", "default", "binding")
+            except NotFoundError:
+                return False
+            return len(obj.status.endpoint_ids) == 1
+
+        assert wait_until(bound)
+        obj = harness.cluster.get("EndpointGroupBinding", "default", "binding")
+        described = harness.aws.describe_endpoint_group(
+            endpoint_group.endpoint_group_arn
+        )
+        weights = {d.endpoint_id: d.weight for d in described.endpoint_descriptions}
+        assert weights[obj.status.endpoint_ids[0]] == 33
+
     def test_delete_with_vanished_endpoint_group(self, harness):
         endpoint_group = self.setup_endpoint_group(harness)
         harness.aws.add_load_balancer(

@@ -1,0 +1,84 @@
+"""Tier-2 e2e, the kind-cluster analog (reference ``e2e/e2e_test.go:78-98``):
+the embedded apiserver routes EndpointGroupBinding admission through
+the real webhook server over HTTP, and the immutability contract is
+enforced end-to-end — an ARN update is rejected with the exact
+message, a weight update is allowed."""
+
+import threading
+
+import pytest
+
+from agac_tpu.apis.endpointgroupbinding import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from agac_tpu.cluster import ObjectMeta
+from agac_tpu.cluster.rest import ClusterAPIError, RestClusterClient
+from agac_tpu.cluster.testserver import TestApiServer
+from agac_tpu.webhook import make_server
+
+
+@pytest.fixture
+def stack():
+    """apiserver + webhook server wired together, like the reference's
+    kind cluster + ValidatingWebhookConfiguration."""
+    webhook_server = make_server(0)
+    webhook_thread = threading.Thread(target=webhook_server.serve_forever, daemon=True)
+    webhook_thread.start()
+    webhook_port = webhook_server.server_address[1]
+    with TestApiServer() as api_server:
+        api_server.register_validating_webhook(
+            "EndpointGroupBinding",
+            f"http://127.0.0.1:{webhook_port}/validate-endpointgroupbinding",
+        )
+        yield RestClusterClient(api_server.url)
+    webhook_server.shutdown()
+    webhook_server.server_close()
+
+
+def make_binding(weight=None):
+    return EndpointGroupBinding(
+        metadata=ObjectMeta(name="binding", namespace="default"),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn="arn:aws:globalaccelerator::123:accelerator/a/listener/l/endpoint-group/e",
+            weight=weight,
+            service_ref=ServiceReference(name="svc"),
+        ),
+    )
+
+
+def test_create_passes_admission(stack):
+    created = stack.create("EndpointGroupBinding", make_binding(weight=50))
+    assert created.metadata.uid
+
+
+def test_arn_update_rejected_through_apiserver(stack):
+    stack.create("EndpointGroupBinding", make_binding(weight=50))
+    obj = stack.get("EndpointGroupBinding", "default", "binding")
+    obj.spec.endpoint_group_arn = "arn:aws:globalaccelerator::123:accelerator/OTHER"
+    with pytest.raises(ClusterAPIError) as exc:
+        stack.update("EndpointGroupBinding", obj)
+    assert exc.value.status == 403
+    assert "Spec.EndpointGroupArn is immutable" in str(exc.value)
+    # object unchanged in the store
+    stored = stack.get("EndpointGroupBinding", "default", "binding")
+    assert stored.spec.endpoint_group_arn.endswith("endpoint-group/e")
+
+
+def test_weight_update_allowed_through_apiserver(stack):
+    stack.create("EndpointGroupBinding", make_binding(weight=50))
+    obj = stack.get("EndpointGroupBinding", "default", "binding")
+    obj.spec.weight = 128
+    updated = stack.update("EndpointGroupBinding", obj)
+    assert updated.spec.weight == 128
+
+
+def test_status_updates_bypass_admission(stack):
+    # the webhook rules cover the main resource only; controllers must
+    # be able to update status freely
+    stack.create("EndpointGroupBinding", make_binding())
+    obj = stack.get("EndpointGroupBinding", "default", "binding")
+    obj.status.endpoint_ids = ["arn:lb1"]
+    updated = stack.update_status("EndpointGroupBinding", obj)
+    assert updated.status.endpoint_ids == ["arn:lb1"]
