@@ -1,0 +1,75 @@
+"""Accelerator-discovery cache.
+
+The reference's hottest path is discovery: every reconcile lists ALL
+accelerators and then calls ListTagsForResource per accelerator —
+O(total accelerators) AWS calls per work item (reference
+``pkg/cloudprovider/aws/global_accelerator.go:87-110``; flagged as the
+hot spot in SURVEY.md §3.2).  This cache memoizes the
+(accelerator, tags) snapshot for a short TTL and is invalidated by
+every mutating driver operation in this process, so:
+
+- a converged steady state (resyncs, level-trigger re-reconciles)
+  costs one AWS list per TTL window instead of per item;
+- any local write immediately invalidates, so a reconcile never acts
+  on its own stale write;
+- cross-process writes (another controller instance) are visible
+  after at most the TTL — the same order of staleness the reference
+  already tolerates between its 30 s informer resyncs, since
+  reconciles are level-triggered and idempotent.
+
+Opt-in: drivers constructed without a cache behave exactly like the
+reference (fresh scan every call).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Callable, Optional
+
+from .types import Accelerator, Tag
+
+Snapshot = list[tuple[Accelerator, list[Tag]]]
+
+
+class DiscoveryCache:
+    def __init__(self, ttl: float = 5.0, clock: Callable[[], float] = time.monotonic):
+        self._ttl = ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._snapshot: Optional[Snapshot] = None
+        self._expires = 0.0
+        self._generation = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, loader: Callable[[], Snapshot]) -> Snapshot:
+        """Return the cached snapshot, loading through ``loader`` when
+        absent or expired.
+
+        The load runs OUTSIDE the lock: during creation storms every
+        write invalidates, and holding the lock across the O(N) scan
+        would convoy all workers behind one loader (measured 2x
+        slowdown).  Concurrent loads are allowed; a loaded snapshot is
+        only stored if no invalidation happened since the load began
+        (generation check), so a stale scan can never mask a newer
+        local write."""
+        with self._lock:
+            if self._snapshot is not None and self._clock() < self._expires:
+                self.hits += 1
+                return copy.deepcopy(self._snapshot)
+            self.misses += 1
+            generation = self._generation
+        snapshot = loader()
+        with self._lock:
+            if self._generation == generation:
+                self._snapshot = snapshot
+                self._expires = self._clock() + self._ttl
+        return copy.deepcopy(snapshot)
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._generation += 1
+            self._snapshot = None
+            self._expires = 0.0

@@ -276,6 +276,7 @@ class AWSDriver:
         sleep: Callable[[float], None] = time.sleep,
         lb_not_active_retry: float = LB_NOT_ACTIVE_RETRY,
         accelerator_missing_retry: float = ACCELERATOR_MISSING_RETRY,
+        discovery_cache=None,
     ):
         self.ga = ga
         self.elbv2 = elbv2
@@ -285,6 +286,10 @@ class AWSDriver:
         self._sleep = sleep
         self._lb_not_active_retry = lb_not_active_retry
         self._accelerator_missing_retry = accelerator_missing_retry
+        # optional shared DiscoveryCache (see cloudprovider/aws/cache.py):
+        # short-circuits the O(N)+1 tag-scan discovery the reference
+        # performs on every reconcile
+        self._discovery_cache = discovery_cache
 
     # ------------------------------------------------------------------
     # ELBv2
@@ -308,10 +313,23 @@ class AWSDriver:
             if token is None:
                 return items
 
+    def _load_discovery_snapshot(self) -> list[tuple[Accelerator, list[Tag]]]:
+        return [
+            (accelerator, self.ga.list_tags_for_resource(accelerator.accelerator_arn))
+            for accelerator in self._list_accelerators()
+        ]
+
+    def _invalidate_discovery(self) -> None:
+        if self._discovery_cache is not None:
+            self._discovery_cache.invalidate()
+
     def _list_by_tags(self, want: dict[str, str]) -> list[Accelerator]:
+        if self._discovery_cache is not None:
+            snapshot = self._discovery_cache.get(self._load_discovery_snapshot)
+        else:
+            snapshot = self._load_discovery_snapshot()
         result = []
-        for accelerator in self._list_accelerators():
-            tags = self.ga.list_tags_for_resource(accelerator.accelerator_arn)
+        for accelerator, tags in snapshot:
             if tags_contains_all_values(tags, want):
                 result.append(accelerator)
             else:
@@ -459,6 +477,7 @@ class AWSDriver:
         accelerator = self.ga.create_accelerator(
             ga_name, IP_ADDRESS_TYPE_IPV4, True, tags
         )
+        self._invalidate_discovery()
         arn = accelerator.accelerator_arn
         klog.infof("Global Accelerator is created: %s", arn)
         try:
@@ -520,6 +539,7 @@ class AWSDriver:
                 ]
                 + accelerator_tags_from_annotations(obj),
             )
+            self._invalidate_discovery()
 
         try:
             listener = self.get_listener(arn)
@@ -669,6 +689,7 @@ class AWSDriver:
         (reference ``global_accelerator.go:724-765``; 10 s / 3 min poll)."""
         klog.infof("Disabling Global Accelerator %s", arn)
         self.ga.update_accelerator(arn, enabled=False)
+        self._invalidate_discovery()
         deadline = time.monotonic() + self._poll_timeout
         while True:
             accelerator = self.ga.describe_accelerator(arn)
@@ -686,6 +707,7 @@ class AWSDriver:
             )
             self._sleep(self._poll_interval)
         self.ga.delete_accelerator(arn)
+        self._invalidate_discovery()
         klog.infof("Global Accelerator is deleted: %s", arn)
 
     # ------------------------------------------------------------------

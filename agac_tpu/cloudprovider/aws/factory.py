@@ -21,12 +21,39 @@ from __future__ import annotations
 import os
 import threading
 
+from .cache import DiscoveryCache
 from .driver import AWSDriver
 from .fake_backend import FakeAWSBackend
 from .load_balancer import get_lb_name_from_hostname
 
 _fake_backend: FakeAWSBackend | None = None
 _lock = threading.Lock()
+# one process-wide discovery cache shared by the per-reconcile drivers
+# (ttl via AGAC_DISCOVERY_CACHE_TTL; 0 disables)
+_discovery_cache: DiscoveryCache | None = None
+
+
+def _shared_discovery_cache() -> DiscoveryCache | None:
+    global _discovery_cache
+    raw = os.environ.get("AGAC_DISCOVERY_CACHE_TTL", "5")
+    try:
+        ttl = float(raw)
+    except ValueError:
+        # a malformed value must not poison every reconcile; fall back
+        # to the default and say so once per process
+        from ... import klog
+
+        klog.errorf(
+            "AGAC_DISCOVERY_CACHE_TTL=%r is not a number; using default 5s", raw
+        )
+        os.environ["AGAC_DISCOVERY_CACHE_TTL"] = "5"
+        ttl = 5.0
+    if ttl <= 0:
+        return None
+    with _lock:
+        if _discovery_cache is None:
+            _discovery_cache = DiscoveryCache(ttl=ttl)
+        return _discovery_cache
 
 
 def _seed_from_environment(backend: FakeAWSBackend) -> None:
@@ -58,10 +85,13 @@ def shared_fake_backend() -> FakeAWSBackend:
 
 
 def real_cloud_factory(region: str) -> AWSDriver:
+    cache = _shared_discovery_cache()
     if os.environ.get("AGAC_CLOUD") == "fake":
         backend = shared_fake_backend()
-        return AWSDriver(backend, backend, backend)
+        return AWSDriver(backend, backend, backend, discovery_cache=cache)
     from .real_backend import RealAWSClients
 
     clients = RealAWSClients.from_environment(region)
-    return AWSDriver(clients.ga, clients.elbv2, clients.route53)
+    return AWSDriver(
+        clients.ga, clients.elbv2, clients.route53, discovery_cache=cache
+    )

@@ -311,4 +311,16 @@ def write_manifests(directory: str) -> list[str]:
     emit("rbac/role_binding.yaml", cluster_role_binding_manifest())
     for name, doc in sample_manifests().items():
         emit(f"samples/{name}", doc)
+
+    # remove orphans: a manifest renamed or dropped from the builders
+    # must disappear from the tree, or the drift check can never catch
+    # the stale committed copy
+    for sub in ("crd", "webhook", "rbac", "samples"):
+        subdir = os.path.join(directory, sub)
+        if not os.path.isdir(subdir):
+            continue
+        for entry in os.listdir(subdir):
+            rel = f"{sub}/{entry}"
+            if entry.endswith(".yaml") and rel not in written:
+                os.remove(os.path.join(subdir, entry))
     return written

@@ -22,6 +22,7 @@ import threading
 import time
 
 from agac_tpu import klog
+from agac_tpu.cloudprovider.aws.cache import DiscoveryCache
 from agac_tpu.apis import (
     AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
     AWS_LOAD_BALANCER_TYPE_ANNOTATION,
@@ -83,11 +84,12 @@ def make_service(i: int) -> Service:
     return svc
 
 
-def run_convergence(workers: int) -> float:
+def run_convergence(workers: int, cache_ttl: float = 0.0) -> float:
     """Create N_SERVICES annotated services, return services/sec until
     every accelerator chain exists."""
     cluster = FakeCluster()
     aws = LatencyAWS()
+    cache = DiscoveryCache(ttl=cache_ttl) if cache_ttl > 0 else None
     for i in range(N_SERVICES):
         aws.add_load_balancer(
             f"bench{i:04d}",
@@ -105,7 +107,7 @@ def run_convergence(workers: int) -> float:
         cluster,
         config,
         stop,
-        cloud_factory=lambda region: AWSDriver(aws, aws, aws),
+        cloud_factory=lambda region: AWSDriver(aws, aws, aws, discovery_cache=cache),
         block=False,
     )
     for i in range(N_SERVICES):
@@ -129,8 +131,12 @@ def main():
     import logging
 
     logging.getLogger("agac").setLevel(logging.CRITICAL)
-    baseline = run_convergence(workers=1)  # the reference's default operating point
-    value = run_convergence(workers=8)
+    # baseline: the reference's operating point — 1 worker per queue,
+    # full O(N)+1 tag-scan discovery on every reconcile
+    baseline = run_convergence(workers=1, cache_ttl=0.0)
+    # measured: this framework's production configuration — concurrent
+    # workers + the shared discovery cache (AGAC_DISCOVERY_CACHE_TTL)
+    value = run_convergence(workers=8, cache_ttl=5.0)
     print(
         json.dumps(
             {

@@ -1,0 +1,104 @@
+"""Discovery-cache tests: hit/miss accounting, TTL expiry,
+invalidation on every mutating driver path, and correctness of the
+cached ensure flow (a reconcile never acts on its own stale write)."""
+
+import pytest
+
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.cache import DiscoveryCache
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+
+
+@pytest.fixture
+def backend():
+    fake = FakeAWSBackend()
+    fake.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+    return fake
+
+
+def make_driver(backend, cache):
+    return AWSDriver(
+        backend, backend, backend,
+        poll_interval=0.001, poll_timeout=1.0, discovery_cache=cache,
+    )
+
+
+def ensure(driver, svc):
+    return driver.ensure_global_accelerator_for_service(
+        svc, svc.status.load_balancer.ingress[0], "default", NLB_NAME, NLB_REGION
+    )
+
+
+def test_ttl_and_explicit_clock():
+    now = [0.0]
+    cache = DiscoveryCache(ttl=5.0, clock=lambda: now[0])
+    loads = []
+    loader = lambda: loads.append(1) or []
+    cache.get(loader)
+    cache.get(loader)
+    assert len(loads) == 1 and cache.hits == 1 and cache.misses == 1
+    now[0] = 6.0  # expired
+    cache.get(loader)
+    assert len(loads) == 2
+
+
+def test_cached_discovery_reduces_aws_calls(backend):
+    cache = DiscoveryCache(ttl=60.0)
+    driver = make_driver(backend, cache)
+    svc = make_lb_service()
+    ensure(driver, svc)  # create pass (invalidates at creation)
+    ensure(driver, svc)  # converged pass: discovery from cache? no — create invalidated
+    before = sum(1 for c in backend.calls if c[0] == "ListAccelerators")
+    for _ in range(10):
+        ensure(driver, svc)  # steady state, no mutations
+    after = sum(1 for c in backend.calls if c[0] == "ListAccelerators")
+    assert after - before <= 1  # at most one refill for 10 reconciles
+
+
+def test_write_invalidates_own_cache(backend):
+    """Create must be visible to the immediately following discovery,
+    or every second reconcile would create a duplicate accelerator."""
+    cache = DiscoveryCache(ttl=60.0)
+    driver = make_driver(backend, cache)
+    svc = make_lb_service()
+    # warm the cache with the empty state
+    assert driver.list_global_accelerator_by_resource("default", "service", "default", "web") == []
+    arn1, created1, _ = ensure(driver, svc)
+    arn2, created2, _ = ensure(driver, svc)
+    assert created1 and not created2
+    assert arn1 == arn2
+    assert len(backend.all_accelerator_arns()) == 1
+
+
+def test_cleanup_invalidates(backend):
+    cache = DiscoveryCache(ttl=60.0)
+    driver = make_driver(backend, cache)
+    svc = make_lb_service()
+    arn, _, _ = ensure(driver, svc)
+    driver.cleanup_global_accelerator(arn)
+    assert driver.list_global_accelerator_by_resource("default", "service", "default", "web") == []
+
+
+def test_shared_cache_across_drivers(backend):
+    """The factory shares one cache across per-reconcile drivers."""
+    cache = DiscoveryCache(ttl=60.0)
+    svc = make_lb_service()
+    ensure(make_driver(backend, cache), svc)
+    before = sum(1 for c in backend.calls if c[0] == "ListAccelerators")
+    for _ in range(5):
+        ensure(make_driver(backend, cache), svc)  # new driver each time
+    after = sum(1 for c in backend.calls if c[0] == "ListAccelerators")
+    assert after - before <= 1
+
+
+def test_snapshot_isolation(backend):
+    """Callers must not be able to corrupt the cached snapshot."""
+    cache = DiscoveryCache(ttl=60.0)
+    driver = make_driver(backend, cache)
+    svc = make_lb_service()
+    ensure(driver, svc)
+    found = driver.list_global_accelerator_by_resource("default", "service", "default", "web")
+    found[0].name = "mutated-by-caller"
+    again = driver.list_global_accelerator_by_resource("default", "service", "default", "web")
+    assert again[0].name == "service-default-web"
