@@ -58,9 +58,16 @@ class DiscoveryCache:
         with self._lock:
             if self._snapshot is not None and self._clock() < self._expires:
                 self.hits += 1
-                return copy.deepcopy(self._snapshot)
-            self.misses += 1
-            generation = self._generation
+                # the snapshot list is replaced wholesale, never
+                # mutated in place, so the copy can happen outside the
+                # lock — hits must not convoy either
+                cached = self._snapshot
+            else:
+                cached = None
+                self.misses += 1
+                generation = self._generation
+        if cached is not None:
+            return copy.deepcopy(cached)
         snapshot = loader()
         with self._lock:
             if self._generation == generation:

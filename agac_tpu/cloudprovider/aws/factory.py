@@ -33,21 +33,33 @@ _lock = threading.Lock()
 _discovery_cache: DiscoveryCache | None = None
 
 
-def _shared_discovery_cache() -> DiscoveryCache | None:
-    global _discovery_cache
+_discovery_ttl: float | None = None
+
+
+def _discovery_cache_ttl() -> float:
+    global _discovery_ttl
+    if _discovery_ttl is not None:
+        return _discovery_ttl
     raw = os.environ.get("AGAC_DISCOVERY_CACHE_TTL", "5")
     try:
         ttl = float(raw)
     except ValueError:
         # a malformed value must not poison every reconcile; fall back
-        # to the default and say so once per process
+        # to the default and say so once per process (memoization
+        # below is the dedup)
         from ... import klog
 
         klog.errorf(
             "AGAC_DISCOVERY_CACHE_TTL=%r is not a number; using default 5s", raw
         )
-        os.environ["AGAC_DISCOVERY_CACHE_TTL"] = "5"
         ttl = 5.0
+    _discovery_ttl = ttl
+    return ttl
+
+
+def _shared_discovery_cache() -> DiscoveryCache | None:
+    global _discovery_cache
+    ttl = _discovery_cache_ttl()
     if ttl <= 0:
         return None
     with _lock:

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import json
 import os
 
 import yaml
@@ -214,6 +215,70 @@ def sample_manifests() -> dict[str, dict]:
                 "ports": [{"name": "http", "port": 80, "protocol": "TCP"}],
             },
         },
+        "nlb-internal-service.yaml": {
+            # wildcard hostname + client-ip-preservation, mirrors the
+            # reference's config/samples/nlb-internal-service.yaml
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": "sample-nlb-internal",
+                "namespace": "default",
+                "annotations": {
+                    apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                    apis.ROUTE53_HOSTNAME_ANNOTATION: "*.internal.example.com",
+                    apis.CLIENT_IP_PRESERVATION_ANNOTATION: "true",
+                    apis.AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    "service.beta.kubernetes.io/aws-load-balancer-nlb-target-type": "instance",
+                    "service.beta.kubernetes.io/aws-load-balancer-scheme": "internet-facing",
+                    "service.beta.kubernetes.io/aws-load-balancer-cross-zone-load-balancing-enabled": "true",
+                },
+            },
+            "spec": {
+                "type": "LoadBalancer",
+                "externalTrafficPolicy": "Local",
+                "selector": {"app": "sample"},
+                "ports": [
+                    {"name": "http", "port": 80, "protocol": "TCP", "targetPort": 80},
+                    {"name": "https", "port": 443, "protocol": "TCP", "targetPort": 443},
+                ],
+            },
+        },
+        "nlb-public-ip-service.yaml": {
+            # ip-target NLB without controller annotations (the LB the
+            # EndpointGroupBinding sample points at), mirrors the
+            # reference's config/samples/nlb-public-ip-service.yaml
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": "sample-nlb-ip",
+                "namespace": "default",
+                "annotations": {
+                    apis.AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    "service.beta.kubernetes.io/aws-load-balancer-nlb-target-type": "ip",
+                    "service.beta.kubernetes.io/aws-load-balancer-scheme": "internet-facing",
+                },
+            },
+            "spec": {
+                "type": "LoadBalancer",
+                "selector": {"app": "sample"},
+                "ports": [{"name": "http", "port": 80, "protocol": "TCP", "targetPort": 80}],
+            },
+        },
+        "service.yaml": {
+            # plain NodePort backend for the ALB ingress sample,
+            # mirrors the reference's config/samples/service.yaml
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "sample", "namespace": "default"},
+            "spec": {
+                "type": "NodePort",
+                "selector": {"app": "sample"},
+                "ports": [
+                    {"name": "http", "port": 80, "protocol": "TCP", "targetPort": 80},
+                    {"name": "https", "port": 443, "protocol": "TCP", "targetPort": 443},
+                ],
+            },
+        },
         "alb-public-ingress.yaml": {
             "apiVersion": "networking.k8s.io/v1",
             "kind": "Ingress",
@@ -225,6 +290,44 @@ def sample_manifests() -> dict[str, dict]:
                     apis.ROUTE53_HOSTNAME_ANNOTATION: "alb.example.com",
                     "alb.ingress.kubernetes.io/scheme": "internet-facing",
                     apis.ALB_LISTEN_PORTS_ANNOTATION: '[{"HTTP":80}]',
+                },
+            },
+            "spec": {
+                "ingressClassName": "alb",
+                "rules": [
+                    {
+                        "http": {
+                            "paths": [
+                                {
+                                    "pathType": "Prefix",
+                                    "path": "/",
+                                    "backend": {
+                                        "service": {
+                                            "name": "sample",
+                                            "port": {"number": 80},
+                                        }
+                                    },
+                                }
+                            ]
+                        }
+                    }
+                ],
+            },
+        },
+        "alb-internal-ingress.yaml": {
+            # internal-scheme ALB with multiple route53 hostnames and
+            # HTTPS listen-ports, mirrors the reference's
+            # config/samples/alb-internal-ingress.yaml
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "Ingress",
+            "metadata": {
+                "name": "sample-alb-internal",
+                "namespace": "default",
+                "annotations": {
+                    apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                    apis.ROUTE53_HOSTNAME_ANNOTATION: "foo.example.com,bar.example.com",
+                    "alb.ingress.kubernetes.io/scheme": "internal",
+                    apis.ALB_LISTEN_PORTS_ANNOTATION: '[{"HTTPS":443}]',
                 },
             },
             "spec": {
@@ -292,6 +395,47 @@ def sample_manifests() -> dict[str, dict]:
     }
 
 
+def iam_policy() -> dict:
+    """The minimal AWS IAM policy the controller needs, as published in
+    the reference's IRSA e2e setup (``local_e2e/cluster.yaml:37-76``)."""
+    return {
+        "Version": "2012-10-17",
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Action": [
+                    "elasticloadbalancing:DescribeLoadBalancers",
+                    "globalaccelerator:DescribeAccelerator",
+                    "globalaccelerator:ListAccelerators",
+                    "globalaccelerator:ListTagsForResource",
+                    "globalaccelerator:TagResource",
+                    "globalaccelerator:CreateAccelerator",
+                    "globalaccelerator:UpdateAccelerator",
+                    "globalaccelerator:DeleteAccelerator",
+                    "globalaccelerator:ListListeners",
+                    "globalaccelerator:CreateListener",
+                    "globalaccelerator:UpdateListener",
+                    "globalaccelerator:DeleteListener",
+                    "globalaccelerator:ListEndpointGroups",
+                    "globalaccelerator:CreateEndpointGroup",
+                    "globalaccelerator:UpdateEndpointGroup",
+                    "globalaccelerator:DeleteEndpointGroup",
+                    "globalaccelerator:AddEndpoints",
+                    "globalaccelerator:RemoveEndpoints",
+                    "route53:ChangeResourceRecordSets",
+                    "route53:ListHostedZones",
+                    # canonical casing; the reference's policy says
+                    # "ListHostedzonesByName" (IAM matches actions
+                    # case-insensitively, so both authorize)
+                    "route53:ListHostedZonesByName",
+                    "route53:ListResourceRecordSets",
+                ],
+                "Resource": "*",
+            }
+        ],
+    }
+
+
 def write_manifests(directory: str) -> list[str]:
     """Regenerate the config tree under ``directory``; returns the
     relative paths written (the ``make manifests`` analog)."""
@@ -312,15 +456,31 @@ def write_manifests(directory: str) -> list[str]:
     for name, doc in sample_manifests().items():
         emit(f"samples/{name}", doc)
 
+    policy_path = os.path.join(directory, "iam", "policy.json")
+    os.makedirs(os.path.dirname(policy_path), exist_ok=True)
+    with open(policy_path, "w") as fh:
+        json.dump(iam_policy(), fh, indent=2)
+        fh.write("\n")
+    written.append("iam/policy.json")
+
     # remove orphans: a manifest renamed or dropped from the builders
     # must disappear from the tree, or the drift check can never catch
-    # the stale committed copy
-    for sub in ("crd", "webhook", "rbac", "samples"):
+    # the stale committed copy — any file under the generated subtrees
+    # not written this run is stale
+    for sub in ("crd", "webhook", "rbac", "samples", "iam"):
         subdir = os.path.join(directory, sub)
         if not os.path.isdir(subdir):
             continue
         for entry in os.listdir(subdir):
             rel = f"{sub}/{entry}"
-            if entry.endswith(".yaml") and rel not in written:
-                os.remove(os.path.join(subdir, entry))
+            path = os.path.join(subdir, entry)
+            # only reap files with generated extensions; user-placed
+            # subdirectories (kustomize overlays) and other files are
+            # not ours to delete
+            if (
+                rel not in written
+                and os.path.isfile(path)
+                and entry.endswith((".yaml", ".json"))
+            ):
+                os.remove(path)
     return written

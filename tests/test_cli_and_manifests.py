@@ -147,6 +147,51 @@ class TestManifests:
             in annotations
         )
 
+    def test_sample_inventory_matches_reference(self):
+        # the reference ships 8 samples (config/samples/); every one
+        # has an analog here
+        assert set(sample_manifests()) == {
+            "nlb-public-service.yaml",
+            "nlb-internal-service.yaml",
+            "nlb-public-ip-service.yaml",
+            "service.yaml",
+            "alb-public-ingress.yaml",
+            "alb-internal-ingress.yaml",
+            "deployment.yaml",
+            "endpointgroupbinding.yaml",
+        }
+
+    def test_iam_policy_covers_driver_calls(self):
+        from agac_tpu.manifests.generate import iam_policy
+
+        actions = set(iam_policy()["Statement"][0]["Action"])
+        # every AWS API family the driver touches is authorized
+        for needed in (
+            "elasticloadbalancing:DescribeLoadBalancers",
+            "globalaccelerator:CreateAccelerator",
+            "globalaccelerator:DeleteEndpointGroup",
+            "globalaccelerator:AddEndpoints",
+            "globalaccelerator:RemoveEndpoints",
+            "route53:ChangeResourceRecordSets",
+            "route53:ListHostedZones",
+            "route53:ListHostedZonesByName",
+            "route53:ListResourceRecordSets",
+        ):
+            assert needed in actions
+
+    def test_orphan_sweep_spares_user_files(self, tmp_path):
+        write_manifests(str(tmp_path))
+        overlay = tmp_path / "samples" / "overlays"
+        overlay.mkdir()
+        keep = tmp_path / "samples" / "README.md"
+        keep.write_text("user notes")
+        stale = tmp_path / "samples" / "dropped.yaml"
+        stale.write_text("kind: Old")
+        write_manifests(str(tmp_path))
+        assert overlay.is_dir()  # subdirectory untouched
+        assert keep.exists()  # non-generated extension untouched
+        assert not stale.exists()  # stale generated file reaped
+
     def test_manifests_cli_writes_tree(self, tmp_path):
         result = run_cli("manifests", "-o", str(tmp_path))
         assert result.returncode == 0
