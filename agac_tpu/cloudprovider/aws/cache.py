@@ -80,3 +80,38 @@ class DiscoveryCache:
             self._generation += 1
             self._snapshot = None
             self._expires = 0.0
+
+    def upsert(self, accelerator: Accelerator, tags: list[Tag]) -> None:
+        """Fold a local create/update into the snapshot instead of
+        discarding it.  During creation storms every item writes; a
+        blanket invalidate would force a full O(N) rescan per write,
+        making convergence O(N^2) AWS calls.  The writer knows exactly
+        the (accelerator, tags) it wrote, so the snapshot can absorb
+        it and stay warm.  Expiry is left unchanged: entries from the
+        original load still refresh within the TTL, so cross-process
+        staleness bounds are unaffected.  The generation bump keeps an
+        in-flight loader (started before this write) from storing a
+        snapshot that misses it."""
+        entry = copy.deepcopy((accelerator, tags))
+        with self._lock:
+            self._generation += 1
+            if self._snapshot is None:
+                return
+            self._snapshot = [
+                item
+                for item in self._snapshot
+                if item[0].accelerator_arn != accelerator.accelerator_arn
+            ] + [entry]
+
+    def remove(self, accelerator_arn: str) -> None:
+        """Drop a locally deleted accelerator from the snapshot (same
+        rationale and generation semantics as ``upsert``)."""
+        with self._lock:
+            self._generation += 1
+            if self._snapshot is None:
+                return
+            self._snapshot = [
+                item
+                for item in self._snapshot
+                if item[0].accelerator_arn != accelerator_arn
+            ]

@@ -323,6 +323,14 @@ class AWSDriver:
         if self._discovery_cache is not None:
             self._discovery_cache.invalidate()
 
+    def _discovery_upsert(self, accelerator: Accelerator, tags: list[Tag]) -> None:
+        if self._discovery_cache is not None:
+            self._discovery_cache.upsert(accelerator, tags)
+
+    def _discovery_remove(self, arn: str) -> None:
+        if self._discovery_cache is not None:
+            self._discovery_cache.remove(arn)
+
     def _list_by_tags(self, want: dict[str, str]) -> list[Accelerator]:
         if self._discovery_cache is not None:
             snapshot = self._discovery_cache.get(self._load_discovery_snapshot)
@@ -477,7 +485,9 @@ class AWSDriver:
         accelerator = self.ga.create_accelerator(
             ga_name, IP_ADDRESS_TYPE_IPV4, True, tags
         )
-        self._invalidate_discovery()
+        # fold the create into the discovery snapshot: a blanket
+        # invalidate here would make creation storms O(N^2) tag scans
+        self._discovery_upsert(accelerator, tags)
         arn = accelerator.accelerator_arn
         klog.infof("Global Accelerator is created: %s", arn)
         try:
@@ -707,7 +717,7 @@ class AWSDriver:
             )
             self._sleep(self._poll_interval)
         self.ga.delete_accelerator(arn)
-        self._invalidate_discovery()
+        self._discovery_remove(arn)
         klog.infof("Global Accelerator is deleted: %s", arn)
 
     # ------------------------------------------------------------------

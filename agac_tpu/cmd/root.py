@@ -53,6 +53,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--disable-leader-election", action="store_true",
         help="Run without acquiring the leader lease (single-replica setups).",
     )
+    controller.add_argument(
+        "--queue-qps", type=float, default=10.0,
+        help="Overall enqueue rate limit per workqueue (token bucket qps).",
+    )
+    controller.add_argument(
+        "--queue-burst", type=int, default=100,
+        help="Enqueue burst size per workqueue (token bucket capacity).",
+    )
 
     webhook = sub.add_parser("webhook", help="Start webhook server")
     webhook.add_argument(
@@ -115,12 +123,17 @@ def run_controller(args) -> int:
         return 1
 
     namespace = os.environ.get("POD_NAMESPACE") or "default"
+    queue_limits = {"queue_qps": args.queue_qps, "queue_burst": args.queue_burst}
     config = ControllerConfig(
         global_accelerator=GlobalAcceleratorConfig(
-            workers=args.workers, cluster_name=args.cluster_name
+            workers=args.workers, cluster_name=args.cluster_name, **queue_limits
         ),
-        route53=Route53Config(workers=args.workers, cluster_name=args.cluster_name),
-        endpoint_group_binding=EndpointGroupBindingConfig(workers=args.workers),
+        route53=Route53Config(
+            workers=args.workers, cluster_name=args.cluster_name, **queue_limits
+        ),
+        endpoint_group_binding=EndpointGroupBindingConfig(
+            workers=args.workers, **queue_limits
+        ),
     )
     stop = setup_signal_handler()
 

@@ -37,7 +37,7 @@ from ..cloudprovider.aws import aws_error_code, get_lb_name_from_hostname, get_r
 from ..cloudprovider.aws.errors import ERR_ENDPOINT_GROUP_NOT_FOUND
 from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
-from ..reconcile import RateLimitingQueue, Result
+from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
 from .common import CloudFactory, GLOBAL_REGION, default_cloud_factory, run_workers
 
 CONTROLLER_AGENT_NAME = "endpoint-group-binding-controller"
@@ -47,6 +47,8 @@ KIND = "EndpointGroupBinding"
 @dataclass
 class EndpointGroupBindingConfig:
     workers: int = 1
+    queue_qps: float = 10.0
+    queue_burst: int = 100
 
 
 class EndpointGroupBindingController:
@@ -61,7 +63,9 @@ class EndpointGroupBindingController:
         self._workers = config.workers
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
-        self.workqueue = RateLimitingQueue(name=KIND)
+        self.workqueue = RateLimitingQueue(
+            controller_rate_limiter(config.queue_qps, config.queue_burst), name=KIND
+        )
 
         self.service_lister = informer_factory.informer("Service").lister()
         self.ingress_lister = informer_factory.informer("Ingress").lister()

@@ -25,7 +25,7 @@ from ..cloudprovider.aws import get_lb_name_from_hostname
 from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import split_meta_namespace_key, meta_namespace_key
 from ..errors import no_retry_errorf
-from ..reconcile import RateLimitingQueue, Result
+from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
 from .common import (
     CloudFactory,
     GLOBAL_REGION,
@@ -45,6 +45,10 @@ CONTROLLER_AGENT_NAME = "global-accelerator-controller"
 class GlobalAcceleratorConfig:
     workers: int = 1
     cluster_name: str = "default"
+    # overall enqueue token bucket (client-go default 10 qps / 100
+    # burst); raise for large fleets — per-item backoff is unaffected
+    queue_qps: float = 10.0
+    queue_burst: int = 100
 
 
 class GlobalAcceleratorController:
@@ -59,8 +63,14 @@ class GlobalAcceleratorController:
         self._workers = config.workers
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
-        self.service_queue = RateLimitingQueue(name=f"{CONTROLLER_AGENT_NAME}-service")
-        self.ingress_queue = RateLimitingQueue(name=f"{CONTROLLER_AGENT_NAME}-ingress")
+        self.service_queue = RateLimitingQueue(
+            controller_rate_limiter(config.queue_qps, config.queue_burst),
+            name=f"{CONTROLLER_AGENT_NAME}-service",
+        )
+        self.ingress_queue = RateLimitingQueue(
+            controller_rate_limiter(config.queue_qps, config.queue_burst),
+            name=f"{CONTROLLER_AGENT_NAME}-ingress",
+        )
 
         service_informer = informer_factory.informer("Service")
         self.service_lister = service_informer.lister()

@@ -25,7 +25,7 @@ from ..cloudprovider.aws import get_lb_name_from_hostname
 from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
 from ..errors import no_retry_errorf
-from ..reconcile import RateLimitingQueue, Result
+from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
 from .common import (
     CloudFactory,
     GLOBAL_REGION,
@@ -44,6 +44,8 @@ CONTROLLER_AGENT_NAME = "route53-controller"
 class Route53Config:
     workers: int = 1
     cluster_name: str = "default"
+    queue_qps: float = 10.0
+    queue_burst: int = 100
 
 
 class Route53Controller:
@@ -58,8 +60,14 @@ class Route53Controller:
         self._workers = config.workers
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
-        self.service_queue = RateLimitingQueue(name=f"{CONTROLLER_AGENT_NAME}-service")
-        self.ingress_queue = RateLimitingQueue(name=f"{CONTROLLER_AGENT_NAME}-ingress")
+        self.service_queue = RateLimitingQueue(
+            controller_rate_limiter(config.queue_qps, config.queue_burst),
+            name=f"{CONTROLLER_AGENT_NAME}-service",
+        )
+        self.ingress_queue = RateLimitingQueue(
+            controller_rate_limiter(config.queue_qps, config.queue_burst),
+            name=f"{CONTROLLER_AGENT_NAME}-ingress",
+        )
 
         service_informer = informer_factory.informer("Service")
         self.service_lister = service_informer.lister()

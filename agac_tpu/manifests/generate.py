@@ -465,22 +465,24 @@ def write_manifests(directory: str) -> list[str]:
 
     # remove orphans: a manifest renamed or dropped from the builders
     # must disappear from the tree, or the drift check can never catch
-    # the stale committed copy — any file under the generated subtrees
-    # not written this run is stale
-    for sub in ("crd", "webhook", "rbac", "samples", "iam"):
+    # the stale committed copy — any file of the extension we generate
+    # in that subtree and not written this run is stale.  User-placed
+    # subdirectories (kustomize overlays) and foreign-extension files
+    # are not ours to delete.
+    generated_ext = {
+        "crd": ".yaml",
+        "webhook": ".yaml",
+        "rbac": ".yaml",
+        "samples": ".yaml",
+        "iam": ".json",
+    }
+    for sub, ext in generated_ext.items():
         subdir = os.path.join(directory, sub)
         if not os.path.isdir(subdir):
             continue
         for entry in os.listdir(subdir):
             rel = f"{sub}/{entry}"
             path = os.path.join(subdir, entry)
-            # only reap files with generated extensions; user-placed
-            # subdirectories (kustomize overlays) and other files are
-            # not ours to delete
-            if (
-                rel not in written
-                and os.path.isfile(path)
-                and entry.endswith((".yaml", ".json"))
-            ):
+            if rel not in written and os.path.isfile(path) and entry.endswith(ext):
                 os.remove(path)
     return written

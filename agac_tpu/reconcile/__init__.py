@@ -11,6 +11,7 @@ from .workqueue import (
     ItemExponentialFailureRateLimiter,
     MaxOfRateLimiter,
     RateLimitingQueue,
+    controller_rate_limiter,
     default_controller_rate_limiter,
 )
 from .reconcile import process_next_work_item
@@ -21,6 +22,7 @@ __all__ = [
     "ItemExponentialFailureRateLimiter",
     "BucketRateLimiter",
     "MaxOfRateLimiter",
+    "controller_rate_limiter",
     "default_controller_rate_limiter",
     "process_next_work_item",
 ]

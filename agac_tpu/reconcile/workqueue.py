@@ -105,9 +105,21 @@ class MaxOfRateLimiter:
 
 def default_controller_rate_limiter() -> MaxOfRateLimiter:
     """The client-go default: per-item exponential + overall bucket."""
+    return controller_rate_limiter(10.0, 100)
+
+
+def controller_rate_limiter(qps: float = 10.0, burst: int = 100) -> MaxOfRateLimiter:
+    """The client-go default shape (per-item exponential + overall
+    bucket) with a tunable bucket — the analog of passing a custom
+    limiter where client-go users outgrow
+    ``DefaultControllerRateLimiter()``'s 10 qps / 100 burst.
+
+    qps <= 0 means "no overall bucket" (per-item backoff only)."""
+    if qps <= 0:
+        return MaxOfRateLimiter(ItemExponentialFailureRateLimiter(0.005, 1000.0))
     return MaxOfRateLimiter(
         ItemExponentialFailureRateLimiter(0.005, 1000.0),
-        BucketRateLimiter(10.0, 100),
+        BucketRateLimiter(qps, burst),
     )
 
 

@@ -48,6 +48,15 @@ class TestCLI:
         assert args.cluster_name == "default"
         assert args.kubeconfig == ""
         assert args.master == ""
+        assert args.queue_qps == 10.0  # client-go default bucket
+        assert args.queue_burst == 100
+
+    def test_controller_queue_limit_flags(self):
+        args = build_parser().parse_args(
+            ["controller", "--queue-qps", "500", "--queue-burst", "1000"]
+        )
+        assert args.queue_qps == 500.0
+        assert args.queue_burst == 1000
 
     def test_controller_short_flags(self):
         args = build_parser().parse_args(["controller", "-w", "4", "-c", "prod"])
@@ -196,3 +205,16 @@ class TestManifests:
         result = run_cli("manifests", "-o", str(tmp_path))
         assert result.returncode == 0
         assert (tmp_path / "rbac" / "role.yaml").exists()
+
+
+def test_orphan_sweep_extension_is_per_subtree(tmp_path):
+    from agac_tpu.manifests.generate import write_manifests
+
+    write_manifests(str(tmp_path))
+    user_json = tmp_path / "samples" / "params.json"
+    user_json.write_text("{}")
+    stale_policy = tmp_path / "iam" / "old.json"
+    stale_policy.write_text("{}")
+    write_manifests(str(tmp_path))
+    assert user_json.exists()  # .json under a yaml subtree is not ours
+    assert not stale_policy.exists()  # stale generated json under iam/ reaped

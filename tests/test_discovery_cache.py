@@ -102,3 +102,75 @@ def test_snapshot_isolation(backend):
     found[0].name = "mutated-by-caller"
     again = driver.list_global_accelerator_by_resource("default", "service", "default", "web")
     assert again[0].name == "service-default-web"
+
+
+def test_create_folds_into_snapshot_without_rescan(backend):
+    """A create upserts into the warm snapshot: no full tag rescan,
+    and the creator immediately sees its own write."""
+    cache = DiscoveryCache(ttl=60.0)
+    driver = make_driver(backend, cache)
+    svc = make_lb_service()
+    ensure(driver, svc)  # warms the cache, then creates (upsert)
+    scans_before = sum(1 for c in backend.calls if c[0] == "ListAccelerators")
+    found = driver.list_global_accelerator_by_resource(
+        "default", "service", "default", "web"
+    )
+    assert len(found) == 1  # own write visible through the cache
+    scans_after = sum(1 for c in backend.calls if c[0] == "ListAccelerators")
+    assert scans_after == scans_before  # served from the upserted snapshot
+
+
+def test_creation_storm_is_linear_in_tag_scans(backend):
+    """N creates against a warm cache cost O(1) full scans, not O(N)
+    (the blanket-invalidate behavior this replaced)."""
+    cache = DiscoveryCache(ttl=60.0)
+    for i in range(8):
+        backend.add_load_balancer(f"storm{i:02d}", NLB_REGION,
+                                  f"storm{i:02d}-0123456789abcdef.elb.us-west-2.amazonaws.com")
+    for i in range(8):
+        svc = make_lb_service(name=f"storm{i:02d}")
+        svc.status.load_balancer.ingress[0].hostname = (
+            f"storm{i:02d}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+        )
+        driver = make_driver(backend, cache)
+        driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "default",
+            f"storm{i:02d}", NLB_REGION,
+        )
+    scans = sum(1 for c in backend.calls if c[0] == "ListAccelerators")
+    assert scans <= 2  # one warming load (+ at most one re-load)
+
+
+def test_delete_removes_from_snapshot(backend):
+    cache = DiscoveryCache(ttl=60.0)
+    driver = make_driver(backend, cache)
+    svc = make_lb_service()
+    arn, _, _ = ensure(driver, svc)
+    driver.list_global_accelerator_by_resource("default", "service", "default", "web")
+    driver.cleanup_global_accelerator(arn)
+    assert (
+        driver.list_global_accelerator_by_resource("default", "service", "default", "web")
+        == []
+    )
+
+
+def test_upsert_blocks_stale_inflight_load():
+    """A loader that began before a write must not be stored over it."""
+    from agac_tpu.cloudprovider.aws.types import Accelerator
+
+    cache = DiscoveryCache(ttl=60.0)
+    acc = Accelerator(
+        accelerator_arn="arn:new", name="n", enabled=True,
+        status="DEPLOYED", dns_name="d",
+    )
+
+    def stale_loader():
+        # write lands while the load is in flight
+        cache.upsert(acc, [])
+        return []  # the stale (pre-write) view
+
+    cache.get(stale_loader)
+    # a fresh get must not see the stale stored snapshot: either it
+    # reloads or serves a snapshot containing the upserted entry
+    snapshot = cache.get(lambda: [(acc, [])])
+    assert any(a.accelerator_arn == "arn:new" for a, _ in snapshot)

@@ -156,3 +156,34 @@ def test_add_rate_limited_delivers(queue):
     queue.add_rate_limited("item")
     item, shutdown = queue.get(timeout=2)
     assert (item, shutdown) == ("item", False)
+
+
+def test_controller_rate_limiter_tunable_bucket():
+    """controller_rate_limiter(qps, burst) keeps the client-go shape
+    (per-item exponential + overall bucket) but with a tunable bucket
+    — the queue_qps/queue_burst production knob."""
+    from agac_tpu.reconcile import controller_rate_limiter
+
+    limiter = controller_rate_limiter(qps=1000.0, burst=3)
+    # within burst the bucket contributes nothing; only the 5 ms
+    # exponential base applies (client-go parity)
+    assert limiter.when("a") == 0.005
+    assert limiter.when("b") == 0.005
+    assert limiter.when("c") == 0.005
+    # per-item exponential still doubles on repeated failures
+    assert limiter.when("a") == 0.01
+    # a slow bucket dominates once the burst is spent
+    slow = controller_rate_limiter(qps=1.0, burst=1)
+    assert slow.when("x") == 0.005  # burst token
+    assert slow.when("y") > 0.5  # throttled at ~1/qps
+
+
+def test_controller_rate_limiter_qps_zero_disables_bucket():
+    """--queue-qps 0 means unlimited: no ZeroDivisionError, per-item
+    exponential backoff still applies."""
+    from agac_tpu.reconcile import controller_rate_limiter
+
+    limiter = controller_rate_limiter(qps=0.0, burst=1)
+    for item in range(50):
+        assert limiter.when(item) == 0.005  # no bucket throttling
+    assert limiter.when(0) == 0.01  # exponential still present
