@@ -15,16 +15,21 @@ run:
 webhook:
 	$(PYTHON) -m agac_tpu webhook --ssl=false --port 8080
 
+CHART_DIR := charts/aws-global-accelerator-controller
+
 .PHONY: manifests
 manifests:
 	$(PYTHON) -m agac_tpu manifests -o config
+	mkdir -p $(CHART_DIR)/crds
+	rm -f $(CHART_DIR)/crds/*.yaml
+	cp config/crd/*.yaml $(CHART_DIR)/crds/
 
 # CI drift check: regenerating manifests must leave the tree clean
 # (the analog of .github/workflows/manifests.yml); porcelain catches
 # untracked/removed generated files too
 .PHONY: check-manifests
 check-manifests: manifests
-	@test -z "$$(git status --porcelain config/)" || { git status config/; exit 1; }
+	@test -z "$$(git status --porcelain config/ $(CHART_DIR)/crds/)" || { git status config/ $(CHART_DIR)/crds/; exit 1; }
 
 .PHONY: bench
 bench:

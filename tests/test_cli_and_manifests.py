@@ -218,3 +218,65 @@ def test_orphan_sweep_extension_is_per_subtree(tmp_path):
     write_manifests(str(tmp_path))
     assert user_json.exists()  # .json under a yaml subtree is not ours
     assert not stale_policy.exists()  # stale generated json under iam/ reaped
+
+
+class TestHelmChart:
+    CHART = os.path.join(REPO, "charts", "aws-global-accelerator-controller")
+
+    def test_chart_structure(self):
+        assert yaml.safe_load(open(os.path.join(self.CHART, "Chart.yaml")))
+        values = yaml.safe_load(open(os.path.join(self.CHART, "values.yaml")))
+        # values backing every templated knob exist
+        assert values["controller"]["queueQps"] == 10
+        # disabled by default so the chart installs without cert-manager
+        assert values["webhook"]["enabled"] is False
+        for name in ("deployment.yaml", "rbac.yaml", "webhook.yaml",
+                     "serviceaccount.yaml", "_helpers.tpl", "NOTES.txt"):
+            assert os.path.exists(os.path.join(self.CHART, "templates", name))
+
+    def test_chart_crd_in_sync_with_generator(self):
+        chart_crd = open(os.path.join(
+            self.CHART, "crds", "operator.h3poteto.dev_endpointgroupbindings.yaml"
+        )).read()
+        assert yaml.safe_load(chart_crd) == crd_manifest()
+
+    def test_templates_have_balanced_actions(self):
+        tpl_dir = os.path.join(self.CHART, "templates")
+        for name in os.listdir(tpl_dir):
+            body = open(os.path.join(tpl_dir, name)).read()
+            assert body.count("{{") == body.count("}}"), name
+            # every if/range/with/define has a matching end
+            import re
+            opens = len(re.findall(r"\{\{-?\s*(?:if|range|with|define)\b", body))
+            ends = len(re.findall(r"\{\{-?\s*end\b", body))
+            assert opens == ends, name
+
+    def test_chart_rbac_matches_generated_role(self):
+        # the static rules block in the chart must grant exactly what
+        # config/rbac/role.yaml (generated) grants
+        body = open(os.path.join(self.CHART, "templates", "rbac.yaml")).read()
+        rules_yaml = body.split("rules:", 1)[1].split("---", 1)[0]
+        chart_rules = yaml.safe_load("rules:" + rules_yaml)["rules"]
+
+        def grant_set(rules):
+            grants = set()
+            for rule in rules:
+                for group in rule["apiGroups"]:
+                    for resource in rule["resources"]:
+                        for verb in rule["verbs"]:
+                            grants.add((group, resource, verb))
+            return grants
+
+        assert grant_set(chart_rules) == grant_set(rbac_manifest()["rules"])
+
+
+    def test_chart_webhook_matches_generated_config(self):
+        # name and rules of the templated ValidatingWebhookConfiguration
+        # must match the generator's (same validation, two deploy paths)
+        body = open(os.path.join(self.CHART, "templates", "webhook.yaml")).read()
+        gen_hook = validating_webhook_manifest()["webhooks"][0]
+        assert f"- name: {gen_hook['name']}" in body
+        assert f"path: {gen_hook['clientConfig']['service']['path']}" in body
+        for resource in gen_hook["rules"][0]["resources"]:
+            assert resource in body
+        assert "failurePolicy: " + gen_hook["failurePolicy"] in body

@@ -1,0 +1,235 @@
+"""Resilience e2e: restart-resume and fault injection.
+
+The reference has no local persistence — desired state lives in the
+kube objects, actual state is re-read from AWS, ownership is recorded
+in the external system itself (GA tags), so a controller restart
+resumes by cache resync (SURVEY.md §5 "checkpoint/resume").  These
+tests prove the rebuild preserves that property: a fresh manager over
+the same cluster+AWS state picks up exactly where the old one left
+off, including repairing a chain a crash left half-created, and AWS
+API faults only delay convergence (rate-limited retry), never corrupt
+it.
+"""
+
+import threading
+import time
+
+import pytest
+
+from agac_tpu import apis
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.driver import (
+    CLUSTER_TAG_KEY,
+    MANAGED_TAG_KEY,
+    OWNER_TAG_KEY,
+    TARGET_HOSTNAME_TAG_KEY,
+)
+from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+from agac_tpu.cloudprovider.aws.types import Tag
+from agac_tpu.cluster import FakeCluster
+from agac_tpu.manager import ControllerConfig, Manager
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+
+POLL_TIMEOUT = 10.0
+
+
+def wait_until(pred, timeout=POLL_TIMEOUT, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def start_manager(cluster, aws, **driver_kwargs):
+    """One controller 'process': returns its stop event."""
+    stop = threading.Event()
+    kwargs = dict(
+        poll_interval=0.01,
+        poll_timeout=2.0,
+        lb_not_active_retry=0.05,
+        accelerator_missing_retry=0.05,
+    )
+    kwargs.update(driver_kwargs)
+    Manager(resync_period=0.3).run(
+        cluster,
+        ControllerConfig(),
+        stop,
+        cloud_factory=lambda region: AWSDriver(aws, aws, aws, **kwargs),
+        block=False,
+    )
+    return stop
+
+
+@pytest.fixture
+def world():
+    cluster = FakeCluster()
+    aws = FakeAWSBackend()
+    aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+    return cluster, aws
+
+
+class TestRestartResume:
+    def test_service_created_while_down_converges_after_restart(self, world):
+        """A Service created during a controller outage is picked up
+        by the next generation's initial list — the trigger is level
+        (current state), not the missed watch event."""
+        cluster, aws = world
+        cluster.create("Service", make_lb_service())
+        assert aws.all_accelerator_arns() == []  # nobody running yet
+
+        stop = start_manager(cluster, aws)
+        try:
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+        finally:
+            stop.set()
+
+    def test_cleanup_resumes_across_generations(self, world):
+        """Convergence state carries across restarts purely through
+        cluster + AWS state: gen1 creates the chain, gen2 (fresh
+        caches, fresh queues) tears it down when the annotation goes
+        away — no handoff, no local persistence."""
+        cluster, aws = world
+        gen1 = start_manager(cluster, aws)
+        cluster.create("Service", make_lb_service())
+        assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+        gen1.set()  # process gone
+        time.sleep(0.1)
+
+        gen2 = start_manager(cluster, aws)
+        try:
+            # the annotation is removed while gen2 is leading; its
+            # update handler fires exactly like gen1's would have
+            svc = cluster.get("Service", "default", "web")
+            del svc.metadata.annotations[
+                apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+            ]
+            cluster.update("Service", svc)
+            assert wait_until(lambda: aws.all_accelerator_arns() == [])
+        finally:
+            gen2.set()
+
+    def test_restart_repairs_half_created_chain(self, world):
+        """A crash after CreateAccelerator but before CreateListener
+        leaves a bare accelerator with ownership tags.  The next
+        generation's update path create-if-missing repairs the chain
+        (reference ``global_accelerator.go:288-347``)."""
+        cluster, aws = world
+        # simulate the torn state the crash left behind: accelerator
+        # with the exact ownership tags, no listener/endpoint group
+        aws.create_accelerator(
+            "service-default-web",
+            "IPV4",
+            True,
+            [
+                Tag(MANAGED_TAG_KEY, "true"),
+                Tag(OWNER_TAG_KEY, "service/default/web"),
+                Tag(TARGET_HOSTNAME_TAG_KEY, NLB_HOSTNAME),
+                Tag(CLUSTER_TAG_KEY, "default"),
+            ],
+        )
+        arn = aws.all_accelerator_arns()[0]
+        assert aws.list_listeners(arn, 100, None)[0] == []
+
+        cluster.create("Service", make_lb_service())
+        stop = start_manager(cluster, aws)
+        try:
+            # no duplicate accelerator; listener + endpoint group added
+            def chain_complete():
+                arns = aws.all_accelerator_arns()
+                if arns != [arn]:
+                    return False
+                listeners, _ = aws.list_listeners(arn, 100, None)
+                if len(listeners) != 1:
+                    return False
+                groups, _ = aws.list_endpoint_groups(listeners[0].listener_arn, 100, None)
+                return len(groups) == 1
+
+            assert wait_until(chain_complete)
+        finally:
+            stop.set()
+
+    def test_external_tamper_repaired_on_next_reconcile(self, world):
+        """An out-of-band endpoint-group deletion is repaired the next
+        time the object is reconciled (any real update re-triggers;
+        resync events with old==new are deliberately dropped, matching
+        the reference's DeepEqual guard, ``controller.go:100-102``)."""
+        cluster, aws = world
+        stop = start_manager(cluster, aws)
+        try:
+            cluster.create("Service", make_lb_service())
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+            arn = aws.all_accelerator_arns()[0]
+            listeners, _ = aws.list_listeners(arn, 100, None)
+            groups, _ = aws.list_endpoint_groups(listeners[0].listener_arn, 100, None)
+            aws.delete_endpoint_group(groups[0].endpoint_group_arn)
+            assert aws.list_endpoint_groups(listeners[0].listener_arn, 100, None)[0] == []
+
+            # any genuine object change re-triggers reconcile
+            svc = cluster.get("Service", "default", "web")
+            svc.metadata.labels["touched"] = "true"
+            cluster.update("Service", svc)
+            assert wait_until(
+                lambda: len(
+                    aws.list_endpoint_groups(listeners[0].listener_arn, 100, None)[0]
+                )
+                == 1
+            )
+        finally:
+            stop.set()
+
+
+class ThrottlingAWS(FakeAWSBackend):
+    """Fails the first N calls of one operation with a retryable API
+    error — the ThrottlingException shape."""
+
+    def __init__(self, op_name: str, failures: int):
+        super().__init__()
+        self._op = op_name
+        self._remaining = failures
+        self.faults_served = 0
+
+    def __getattribute__(self, name):
+        attr = super().__getattribute__(name)
+        if name == object.__getattribute__(self, "_op"):
+            def maybe_fail(*args, **kwargs):
+                if self._remaining > 0:
+                    self._remaining -= 1
+                    self.faults_served += 1
+                    raise AWSAPIError("ThrottlingException", "Rate exceeded")
+                return attr(*args, **kwargs)
+
+            return maybe_fail
+        return attr
+
+
+class TestFaultInjection:
+    def test_create_listener_throttled_then_converges(self, world):
+        """Mid-chain failure triggers rollback (no orphaned
+        accelerator) and rate-limited retry eventually converges."""
+        cluster, _ = world
+        aws = ThrottlingAWS("create_listener", failures=2)
+        aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        stop = start_manager(cluster, aws)
+        try:
+            cluster.create("Service", make_lb_service())
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+            arn = aws.all_accelerator_arns()[0]
+            assert wait_until(lambda: len(aws.list_listeners(arn, 100, None)[0]) == 1)
+            assert aws.faults_served == 2
+        finally:
+            stop.set()
+
+    def test_describe_lb_outage_retries_until_healthy(self, world):
+        cluster, _ = world
+        aws = ThrottlingAWS("describe_load_balancers", failures=3)
+        aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        stop = start_manager(cluster, aws)
+        try:
+            cluster.create("Service", make_lb_service())
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+            assert aws.faults_served == 3
+        finally:
+            stop.set()
