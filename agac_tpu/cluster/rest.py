@@ -24,6 +24,8 @@ import ssl
 import tempfile
 import urllib.error
 import urllib.parse
+import threading
+import time
 import urllib.request
 from typing import Any, Callable, Iterator, Optional
 
@@ -87,9 +89,13 @@ class RestClusterClient(ClusterClient):
         token: Optional[str] = None,
         ssl_context: Optional[ssl.SSLContext] = None,
         transport: Optional[Callable] = None,
+        token_provider: Optional[Callable[[], Optional[str]]] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self._token = token
+        # dynamic credentials (exec plugins, rotated token files)
+        # re-resolved per request; wins over the static token
+        self._token_provider = token_provider
         self._ssl_context = ssl_context
         self._transport = transport or self._default_transport
 
@@ -116,13 +122,28 @@ class RestClusterClient(ClusterClient):
     ):
         url = f"{self.base_url}/{path}"
         headers = {"Accept": "application/json"}
-        if self._token:
-            headers["Authorization"] = f"Bearer {self._token}"
+        token = self._token_provider() if self._token_provider else self._token
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         data = None
         if body is not None:
             headers["Content-Type"] = "application/json"
             data = json.dumps(body).encode()
-        return self._transport(method, url, headers, data, timeout, stream)
+        status, payload = self._transport(method, url, headers, data, timeout, stream)
+        if status == 401 and self._token_provider is not None:
+            # the server rejected the cached credential (early
+            # revocation, clock skew): force a refresh and retry once,
+            # like client-go's exec authenticator
+            invalidate = getattr(self._token_provider, "invalidate", None)
+            if invalidate is not None:
+                invalidate()
+                token = self._token_provider()
+                if token:
+                    headers["Authorization"] = f"Bearer {token}"
+                status, payload = self._transport(
+                    method, url, headers, data, timeout, stream
+                )
+        return status, payload
 
     # ------------------------------------------------------------------
     # paths and serde
@@ -282,13 +303,97 @@ def _b64_to_tempfile(data_b64: str, suffix: str) -> str:
     return handle.name
 
 
+class ExecCredentialProvider:
+    """client.authentication.k8s.io exec-plugin credentials — how
+    kubectl authenticates to EKS (``aws eks get-token``).  Runs the
+    configured command, parses the ExecCredential JSON, caches the
+    token until its expirationTimestamp (re-execs ~1 min early)."""
+
+    def __init__(self, exec_spec: dict):
+        self._spec = exec_spec
+        self._lock = threading.Lock()
+        self._token: Optional[str] = None
+        self._expires: float = 0.0
+
+    def __call__(self) -> Optional[str]:
+        with self._lock:
+            if self._token is not None and (
+                self._expires == 0.0 or time.time() < self._expires - 60
+            ):
+                return self._token
+            self._token, self._expires = self._fetch()
+            return self._token
+
+    def invalidate(self) -> None:
+        """Drop the cached token so the next call re-execs — the
+        client retries once with a fresh credential when the server
+        rejects the cached one (early revocation, clock skew)."""
+        with self._lock:
+            self._token = None
+            self._expires = 0.0
+
+    def _fetch(self) -> tuple[Optional[str], float]:
+        import subprocess
+
+        command = [self._spec["command"]] + list(self._spec.get("args") or [])
+        env = dict(os.environ)
+        for pair in self._spec.get("env") or []:
+            env[pair["name"]] = pair["value"]
+        try:
+            result = subprocess.run(
+                command, env=env, capture_output=True, text=True, timeout=60
+            )
+        except subprocess.TimeoutExpired as err:
+            raise ClusterAPIError(
+                401, f"exec credential plugin {command[0]!r} timed out after 60s"
+            ) from err
+        if result.returncode != 0:
+            raise ClusterAPIError(
+                401,
+                f"exec credential plugin {command[0]!r} failed: {result.stderr.strip()}",
+            )
+        try:
+            credential = json.loads(result.stdout)
+        except ValueError as err:
+            raise ClusterAPIError(
+                401,
+                f"exec credential plugin {command[0]!r} printed invalid JSON",
+            ) from err
+        status = credential.get("status") or {}
+        token = status.get("token")
+        raw_expiry = status.get("expirationTimestamp")
+        if not raw_expiry:
+            return token, 0.0  # no expiry advertised: cache for the process
+        import datetime
+
+        try:
+            expires = datetime.datetime.fromisoformat(
+                raw_expiry.replace("Z", "+00:00")
+            ).timestamp()
+        except ValueError:
+            # unparseable expiry must fail STALE (re-exec next call),
+            # never "never expires"
+            expires = time.time()
+        return token, expires
+
+
+def _token_file_provider(path: str) -> Callable[[], Optional[str]]:
+    """Re-reads a rotated token file (projected SA tokens) per request."""
+
+    def provider() -> Optional[str]:
+        with open(path) as fh:
+            return fh.read().strip()
+
+    return provider
+
+
 def build_client_from_kubeconfig(
     kubeconfig_path: str, master_url: str = "", context_name: str = ""
 ) -> RestClusterClient:
     """Parse a kubeconfig (the subset covering clusters/users/contexts
-    with certificate/token auth) and build a client; ``master_url``
-    overrides the cluster server like the reference's ``--master``
-    flag."""
+    with certificate/token/exec-plugin auth) and build a client;
+    ``master_url`` overrides the cluster server like the reference's
+    ``--master`` flag."""
     import yaml
 
     with open(kubeconfig_path) as fh:
@@ -329,7 +434,14 @@ def build_client_from_kubeconfig(
             ssl_context.load_cert_chain(cert_file, key_file)
 
     token = user.get("token")
-    return RestClusterClient(server, token=token, ssl_context=ssl_context)
+    token_provider: Optional[Callable[[], Optional[str]]] = None
+    if user.get("exec"):
+        token_provider = ExecCredentialProvider(user["exec"])
+    elif user.get("tokenFile"):
+        token_provider = _token_file_provider(user["tokenFile"])
+    return RestClusterClient(
+        server, token=token, ssl_context=ssl_context, token_provider=token_provider
+    )
 
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -342,13 +454,17 @@ def build_in_cluster_client() -> RestClusterClient:
     port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
     if not host:
         raise RuntimeError("not running in a cluster (KUBERNETES_SERVICE_HOST unset)")
-    with open(os.path.join(SERVICE_ACCOUNT_DIR, "token")) as fh:
-        token = fh.read().strip()
+    token_path = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+    with open(token_path):
+        pass  # fail fast if the mount is missing
     ssl_context = ssl.create_default_context(
         cafile=os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
     )
+    # projected SA tokens rotate; re-read per request like client-go
     return RestClusterClient(
-        f"https://{host}:{port}", token=token, ssl_context=ssl_context
+        f"https://{host}:{port}",
+        ssl_context=ssl_context,
+        token_provider=_token_file_provider(token_path),
     )
 
 

@@ -218,3 +218,187 @@ def test_watch_url_has_server_timeout(client, stub):
     stub.queue(200, b"")
     list(client.watch("Service", "0", lambda: False))
     assert "timeoutSeconds=240" in stub.requests[0][1]
+
+
+class TestExecCredentials:
+    """Exec-plugin auth (the `aws eks get-token` path) and rotated
+    token files — client-go credential parity the EKS audience needs."""
+
+    def _exec_spec(self, tmp_path, token="exec-token", expires_in=3600, calls_file=None):
+        import datetime
+
+        expiry = (
+            datetime.datetime.now(datetime.timezone.utc)
+            + datetime.timedelta(seconds=expires_in)
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
+        script = tmp_path / "get-token.py"
+        count_line = (
+            f"open({str(calls_file)!r}, 'a').write('x')\n" if calls_file else ""
+        )
+        script.write_text(
+            "import json, os, sys\n"
+            + count_line
+            + "print(json.dumps({"
+            "'apiVersion': 'client.authentication.k8s.io/v1beta1',"
+            "'kind': 'ExecCredential',"
+            f"'status': {{'token': os.environ.get('FAKE_TOKEN', {token!r}),"
+            f" 'expirationTimestamp': {expiry!r}}}}}))\n"
+        )
+        import sys
+
+        return {"command": sys.executable, "args": [str(script)]}
+
+    def test_exec_provider_returns_and_caches_token(self, tmp_path):
+        from agac_tpu.cluster.rest import ExecCredentialProvider
+
+        calls = tmp_path / "calls"
+        provider = ExecCredentialProvider(
+            self._exec_spec(tmp_path, calls_file=calls)
+        )
+        assert provider() == "exec-token"
+        assert provider() == "exec-token"  # cached, not re-executed
+        assert calls.read_text() == "x"
+
+    def test_exec_provider_re_execs_after_expiry(self, tmp_path):
+        from agac_tpu.cluster.rest import ExecCredentialProvider
+
+        calls = tmp_path / "calls"
+        provider = ExecCredentialProvider(
+            self._exec_spec(tmp_path, expires_in=30, calls_file=calls)
+        )
+        provider()
+        provider()  # within the 60s refresh margin of a 30s expiry -> re-exec
+        assert calls.read_text() == "xx"
+
+    def test_exec_provider_env_passthrough(self, tmp_path):
+        from agac_tpu.cluster.rest import ExecCredentialProvider
+
+        spec = self._exec_spec(tmp_path)
+        spec["env"] = [{"name": "FAKE_TOKEN", "value": "from-env"}]
+        assert ExecCredentialProvider(spec)() == "from-env"
+
+    def test_exec_failure_raises_api_error(self, tmp_path):
+        from agac_tpu.cluster.rest import ExecCredentialProvider
+
+        import sys
+
+        provider = ExecCredentialProvider(
+            {"command": sys.executable, "args": ["-c", "import sys; sys.exit(3)"]}
+        )
+        with pytest.raises(ClusterAPIError):
+            provider()
+
+    def test_kubeconfig_exec_user_sends_bearer(self, tmp_path, stub):
+        import sys
+        import yaml
+
+        spec = self._exec_spec(tmp_path)
+        kubeconfig = {
+            "current-context": "t",
+            "contexts": [{"name": "t", "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {"server": "http://api:8080"}}],
+            "users": [{"name": "u", "user": {"exec": spec}}],
+        }
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump(kubeconfig))
+        client = build_client_from_kubeconfig(str(path))
+        client._transport = stub
+        stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
+        client.get("Service", "default", "web")
+        assert stub.requests[0][2]["Authorization"] == "Bearer exec-token"
+
+    def test_kubeconfig_token_file_rereads(self, tmp_path, stub):
+        import yaml
+
+        token_path = tmp_path / "token"
+        token_path.write_text("first\n")
+        kubeconfig = {
+            "current-context": "t",
+            "contexts": [{"name": "t", "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {"server": "http://api:8080"}}],
+            "users": [{"name": "u", "user": {"tokenFile": str(token_path)}}],
+        }
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump(kubeconfig))
+        client = build_client_from_kubeconfig(str(path))
+        client._transport = stub
+        stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
+        client.get("Service", "default", "web")
+        assert stub.requests[0][2]["Authorization"] == "Bearer first"
+        token_path.write_text("rotated\n")  # kubelet rotates the projected token
+        stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
+        client.get("Service", "default", "web")
+        assert stub.requests[1][2]["Authorization"] == "Bearer rotated"
+
+    def test_unparseable_expiry_fails_stale_not_cached_forever(self, tmp_path):
+        import sys
+
+        from agac_tpu.cluster.rest import ExecCredentialProvider
+
+        calls = tmp_path / "calls"
+        script = tmp_path / "bad-expiry.py"
+        script.write_text(
+            "import json\n"
+            f"open({str(calls)!r}, 'a').write('x')\n"
+            "print(json.dumps({'status': {'token': 't',"
+            " 'expirationTimestamp': 'not-a-timestamp'}}))\n"
+        )
+        provider = ExecCredentialProvider(
+            {"command": sys.executable, "args": [str(script)]}
+        )
+        provider()
+        provider()  # stale expiry -> re-exec, not cached forever
+        assert calls.read_text() == "xx"
+
+    def test_offset_form_expiry_parses(self, tmp_path):
+        import sys
+
+        from agac_tpu.cluster.rest import ExecCredentialProvider
+
+        calls = tmp_path / "calls"
+        script = tmp_path / "offset.py"
+        script.write_text(
+            "import json, datetime\n"
+            f"open({str(calls)!r}, 'a').write('x')\n"
+            "exp = (datetime.datetime.now(datetime.timezone.utc)"
+            " + datetime.timedelta(hours=1)).isoformat()\n"  # +00:00 offset form
+            "print(json.dumps({'status': {'token': 't', 'expirationTimestamp': exp}}))\n"
+        )
+        provider = ExecCredentialProvider(
+            {"command": sys.executable, "args": [str(script)]}
+        )
+        provider()
+        provider()  # valid 1h expiry -> cached
+        assert calls.read_text() == "x"
+
+    def test_hang_and_bad_json_wrapped_as_api_error(self, tmp_path):
+        import sys
+
+        from agac_tpu.cluster.rest import ExecCredentialProvider
+
+        bad_json = ExecCredentialProvider(
+            {"command": sys.executable, "args": ["-c", "print('not json')"]}
+        )
+        with pytest.raises(ClusterAPIError):
+            bad_json()
+
+    def test_401_forces_reexec_and_single_retry(self, tmp_path, stub):
+        import sys
+        import yaml
+
+        spec = self._exec_spec(tmp_path)
+        kubeconfig = {
+            "current-context": "t",
+            "contexts": [{"name": "t", "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {"server": "http://api:8080"}}],
+            "users": [{"name": "u", "user": {"exec": spec}}],
+        }
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump(kubeconfig))
+        client = build_client_from_kubeconfig(str(path))
+        client._transport = stub
+        stub.queue(401, {"message": "token expired"})
+        stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
+        client.get("Service", "default", "web")  # retried transparently
+        assert len(stub.requests) == 2
+        assert stub.requests[1][2]["Authorization"].startswith("Bearer ")
