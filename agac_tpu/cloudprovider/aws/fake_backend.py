@@ -26,6 +26,7 @@ from __future__ import annotations
 import itertools
 import threading
 import uuid
+from dataclasses import replace
 from typing import Optional
 
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
@@ -61,10 +62,6 @@ from .types import (
 )
 
 _ACCOUNT = "123456789012"
-
-
-def _copy_accelerator(a: Accelerator) -> Accelerator:
-    return Accelerator(**vars(a))
 
 
 def _paginate(items: list, max_results: int, next_token: Optional[str]):
@@ -157,7 +154,9 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         if state.pending_describes > 0:
             state.pending_describes -= 1
             if state.pending_describes == 0:
-                state.accelerator.status = ACCELERATOR_STATUS_DEPLOYED
+                state.accelerator = replace(
+                    state.accelerator, status=ACCELERATOR_STATUS_DEPLOYED
+                )
 
     def _get_state(self, arn: str) -> _AcceleratorState:
         state = self._accelerators.get(arn)
@@ -170,7 +169,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             self.calls.append(("ListAccelerators",))
             for state in self._accelerators.values():
                 self._settle(state)
-            items = [_copy_accelerator(s.accelerator) for s in self._accelerators.values()]
+            items = [s.accelerator for s in self._accelerators.values()]
             return _paginate(items, max_results, next_token)
 
     def describe_accelerator(self, arn):
@@ -178,7 +177,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             self.calls.append(("DescribeAccelerator", arn))
             state = self._get_state(arn)
             self._settle(state)
-            return _copy_accelerator(state.accelerator)
+            return state.accelerator
 
     def create_accelerator(self, name, ip_address_type, enabled, tags):
         with self._lock:
@@ -199,20 +198,22 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
                 accelerator, list(tags), self.settle_describes
             )
             self.calls.append(("CreateAccelerator", arn))
-            return _copy_accelerator(accelerator)
+            return accelerator
 
     def update_accelerator(self, arn, name=None, enabled=None):
         with self._lock:
             state = self._get_state(arn)
+            changes = {}
             if name is not None:
-                state.accelerator.name = name
+                changes["name"] = name
             if enabled is not None:
-                state.accelerator.enabled = enabled
+                changes["enabled"] = enabled
             if self.settle_describes:
-                state.accelerator.status = ACCELERATOR_STATUS_IN_PROGRESS
+                changes["status"] = ACCELERATOR_STATUS_IN_PROGRESS
                 state.pending_describes = self.settle_describes
+            state.accelerator = replace(state.accelerator, **changes)
             self.calls.append(("UpdateAccelerator", arn))
-            return _copy_accelerator(state.accelerator)
+            return state.accelerator
 
     def delete_accelerator(self, arn):
         with self._lock:

@@ -43,13 +43,16 @@ CHANGE_ACTION_UPSERT = "UPSERT"
 GLOBAL_ACCELERATOR_HOSTED_ZONE_ID = "Z2BJ6XQ5FK7U4H"
 
 
-@dataclass
+# Tag and Accelerator are frozen: they flow through the shared
+# DiscoveryCache snapshot (cache.py), which hands the same objects to
+# every worker without defensive copies.
+@dataclass(frozen=True)
 class Tag:
     key: str
     value: str
 
 
-@dataclass
+@dataclass(frozen=True)
 class Accelerator:
     accelerator_arn: str = ""
     name: str = ""

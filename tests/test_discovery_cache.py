@@ -2,6 +2,7 @@
 invalidation on every mutating driver path, and correctness of the
 cached ensure flow (a reconcile never acts on its own stale write)."""
 
+import dataclasses
 import pytest
 
 from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
@@ -93,13 +94,16 @@ def test_shared_cache_across_drivers(backend):
 
 
 def test_snapshot_isolation(backend):
-    """Callers must not be able to corrupt the cached snapshot."""
+    """Callers must not be able to corrupt the cached snapshot: entries
+    are shared (no per-read copy), so Accelerator is frozen and any
+    mutation attempt raises instead of silently poisoning the cache."""
     cache = DiscoveryCache(ttl=60.0)
     driver = make_driver(backend, cache)
     svc = make_lb_service()
     ensure(driver, svc)
     found = driver.list_global_accelerator_by_resource("default", "service", "default", "web")
-    found[0].name = "mutated-by-caller"
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        found[0].name = "mutated-by-caller"
     again = driver.list_global_accelerator_by_resource("default", "service", "default", "web")
     assert again[0].name == "service-default-web"
 
