@@ -140,6 +140,10 @@ class RestClusterClient(ClusterClient):
                 token = self._token_provider()
                 if token:
                     headers["Authorization"] = f"Bearer {token}"
+                else:
+                    # refresh yielded nothing — never resend the header
+                    # the server just rejected
+                    headers.pop("Authorization", None)
                 status, payload = self._transport(
                     method, url, headers, data, timeout, stream
                 )
@@ -309,8 +313,9 @@ class ExecCredentialProvider:
     configured command, parses the ExecCredential JSON, caches the
     token until its expirationTimestamp (re-execs ~1 min early)."""
 
-    def __init__(self, exec_spec: dict):
+    def __init__(self, exec_spec: dict, timeout: float = 60.0):
         self._spec = exec_spec
+        self._timeout = timeout
         self._lock = threading.Lock()
         self._token: Optional[str] = None
         self._expires: float = 0.0
@@ -341,11 +346,12 @@ class ExecCredentialProvider:
             env[pair["name"]] = pair["value"]
         try:
             result = subprocess.run(
-                command, env=env, capture_output=True, text=True, timeout=60
+                command, env=env, capture_output=True, text=True, timeout=self._timeout
             )
         except subprocess.TimeoutExpired as err:
             raise ClusterAPIError(
-                401, f"exec credential plugin {command[0]!r} timed out after 60s"
+                401,
+                f"exec credential plugin {command[0]!r} timed out after {self._timeout}s",
             ) from err
         if result.returncode != 0:
             raise ClusterAPIError(
@@ -377,14 +383,50 @@ class ExecCredentialProvider:
         return token, expires
 
 
-def _token_file_provider(path: str) -> Callable[[], Optional[str]]:
-    """Re-reads a rotated token file (projected SA tokens) per request."""
+class TokenFileProvider:
+    """Rotated token files (projected SA tokens).  The token is cached
+    for a short TTL like client-go's file-token cache (~1 min) instead
+    of paying an open/read/close on every API request; ``invalidate``
+    forces a re-read, which wires token files into the client's
+    401-refresh retry."""
 
-    def provider() -> Optional[str]:
-        with open(path) as fh:
-            return fh.read().strip()
+    def __init__(self, path: str, ttl: float = 60.0):
+        self._path = path
+        self._ttl = ttl
+        self._lock = threading.Lock()
+        self._token: Optional[str] = None
+        self._fresh_until = 0.0
 
-    return provider
+    def __call__(self) -> Optional[str]:
+        with self._lock:
+            now = time.time()
+            if self._token is not None and now < self._fresh_until:
+                return self._token
+            try:
+                with open(self._path) as fh:
+                    self._token = fh.read().strip()
+            except OSError as err:
+                if self._token is not None:
+                    # transient rotate failure: keep serving the cached
+                    # token (client-go's cachingTokenSource does the
+                    # same); invalidate() clears it, so real auth
+                    # failures still surface through the 401 path
+                    klog.warningf(
+                        "token file %s unreadable, serving cached token: %s",
+                        self._path,
+                        err,
+                    )
+                    return self._token
+                raise ClusterAPIError(
+                    401, f"token file {self._path!r} unreadable: {err}"
+                ) from err
+            self._fresh_until = now + self._ttl
+            return self._token
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._token = None
+            self._fresh_until = 0.0
 
 
 def build_client_from_kubeconfig(
@@ -437,8 +479,9 @@ def build_client_from_kubeconfig(
     token_provider: Optional[Callable[[], Optional[str]]] = None
     if user.get("exec"):
         token_provider = ExecCredentialProvider(user["exec"])
-    elif user.get("tokenFile"):
-        token_provider = _token_file_provider(user["tokenFile"])
+    elif user.get("tokenFile") and not token:
+        # clientcmd gives a static `token` priority over `tokenFile`
+        token_provider = TokenFileProvider(user["tokenFile"])
     return RestClusterClient(
         server, token=token, ssl_context=ssl_context, token_provider=token_provider
     )
@@ -460,11 +503,11 @@ def build_in_cluster_client() -> RestClusterClient:
     ssl_context = ssl.create_default_context(
         cafile=os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
     )
-    # projected SA tokens rotate; re-read per request like client-go
+    # projected SA tokens rotate; cached re-reads like client-go
     return RestClusterClient(
         f"https://{host}:{port}",
         ssl_context=ssl_context,
-        token_provider=_token_file_provider(token_path),
+        token_provider=TokenFileProvider(token_path),
     )
 
 

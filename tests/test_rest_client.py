@@ -23,7 +23,8 @@ class StubTransport:
         self.responses.append((status, body if isinstance(body, bytes) else json.dumps(body).encode()))
 
     def __call__(self, method, url, headers, body, timeout, stream):
-        self.requests.append((method, url, headers, body))
+        # copy: the client reuses one headers dict across a 401 retry
+        self.requests.append((method, url, dict(headers), body))
         status, payload = self.responses.pop(0)
         if stream:
             import io
@@ -328,7 +329,65 @@ class TestExecCredentials:
         token_path.write_text("rotated\n")  # kubelet rotates the projected token
         stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
         client.get("Service", "default", "web")
-        assert stub.requests[1][2]["Authorization"] == "Bearer rotated"
+        # cached within the TTL (client-go caches file tokens too) ...
+        assert stub.requests[1][2]["Authorization"] == "Bearer first"
+        client._token_provider.invalidate()
+        stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
+        client.get("Service", "default", "web")
+        # ... and the rotation lands after invalidate (or TTL expiry)
+        assert stub.requests[2][2]["Authorization"] == "Bearer rotated"
+
+    def test_token_file_provider_caching_401_refresh_and_errors(self, tmp_path, stub):
+        from agac_tpu.cluster.rest import RestClusterClient, TokenFileProvider
+
+        token_path = tmp_path / "token"
+        token_path.write_text("first\n")
+        provider = TokenFileProvider(str(token_path), ttl=60.0)
+        client = RestClusterClient("http://api:8080", token_provider=provider)
+        client._transport = stub
+        # a 401 invalidates the cache, so the retry carries the rotated token
+        stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
+        client.get("Service", "default", "web")
+        token_path.write_text("rotated\n")
+        stub.queue(401, {"message": "token expired"})
+        stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
+        client.get("Service", "default", "web")
+        assert stub.requests[-1][2]["Authorization"] == "Bearer rotated"
+        # transient read failure after expiry: serve the cached token
+        # (client-go's cachingTokenSource semantics)
+        provider._fresh_until = 0.0
+        token_path.unlink()
+        assert provider() == "rotated"
+        # but with no cached token at all (invalidate = real 401 path),
+        # the failure surfaces as ClusterAPIError, not raw OSError
+        provider.invalidate()
+        with pytest.raises(ClusterAPIError, match="unreadable"):
+            provider()
+
+    def test_kubeconfig_static_token_beats_token_file(self, tmp_path, stub):
+        """clientcmd precedence: `token` wins over `tokenFile`."""
+        import yaml
+
+        token_path = tmp_path / "token"
+        token_path.write_text("from-file\n")
+        kubeconfig = {
+            "current-context": "t",
+            "contexts": [{"name": "t", "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {"server": "http://api:8080"}}],
+            "users": [
+                {
+                    "name": "u",
+                    "user": {"token": "static", "tokenFile": str(token_path)},
+                }
+            ],
+        }
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump(kubeconfig))
+        client = build_client_from_kubeconfig(str(path))
+        client._transport = stub
+        stub.queue(200, {"metadata": {"name": "web", "namespace": "default"}})
+        client.get("Service", "default", "web")
+        assert stub.requests[0][2]["Authorization"] == "Bearer static"
 
     def test_unparseable_expiry_fails_stale_not_cached_forever(self, tmp_path):
         import sys
@@ -382,6 +441,13 @@ class TestExecCredentials:
         with pytest.raises(ClusterAPIError):
             bad_json()
 
+        hang = ExecCredentialProvider(
+            {"command": sys.executable, "args": ["-c", "import time; time.sleep(30)"]},
+            timeout=0.2,
+        )
+        with pytest.raises(ClusterAPIError, match="timed out"):
+            hang()
+
     def test_401_forces_reexec_and_single_retry(self, tmp_path, stub):
         import sys
         import yaml
@@ -402,3 +468,30 @@ class TestExecCredentials:
         client.get("Service", "default", "web")  # retried transparently
         assert len(stub.requests) == 2
         assert stub.requests[1][2]["Authorization"].startswith("Bearer ")
+
+    def test_401_with_empty_refresh_drops_rejected_header(self, stub):
+        """If the forced refresh yields no token, the retry must not
+        resend the Authorization header the server just rejected."""
+        from agac_tpu.cluster.rest import RestClusterClient
+
+        class EmptyAfterInvalidate:
+            def __init__(self):
+                self.token = "stale-token"
+
+            def __call__(self):
+                return self.token
+
+            def invalidate(self):
+                self.token = None
+
+        client = RestClusterClient(
+            "http://api:8080", token_provider=EmptyAfterInvalidate()
+        )
+        client._transport = stub
+        stub.queue(401, {"message": "token expired"})
+        stub.queue(401, {"message": "no credentials"})
+        with pytest.raises(ClusterAPIError):
+            client.get("Service", "default", "web")
+        assert len(stub.requests) == 2
+        assert stub.requests[0][2]["Authorization"] == "Bearer stale-token"
+        assert "Authorization" not in stub.requests[1][2]
