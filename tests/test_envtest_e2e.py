@@ -178,3 +178,116 @@ class TestFullStackOverHTTP:
             assert wait_until(lambda: aws.records_in_zone(zone.id) == [])
         finally:
             stop.set()
+
+
+class TestLeaderFailoverOverHTTP:
+    def test_standby_takes_over_and_reconciles(self, server, client):
+        """Two contenders, one lease, one active manager at a time
+        (SURVEY.md §5 recovery mechanism 1).  When the leader goes
+        away, the standby acquires the lease through the apiserver and
+        its manager converges work created after the failover."""
+        aws = FakeAWSBackend()
+        aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        le_config = LeaderElectionConfig(
+            lease_duration=1, renew_deadline=0.5, retry_period=0.05
+        )
+        driver_kwargs = dict(
+            poll_interval=0.01, poll_timeout=2.0,
+            lb_not_active_retry=0.1, accelerator_missing_retry=0.1,
+        )
+
+        def contender(name):
+            stop = threading.Event()
+            election = LeaderElection("agac-ha", "default", le_config, identity=name)
+            contender_client = RestClusterClient(server.url)
+
+            def run_fn(stop_event):
+                Manager(resync_period=0.5).run(
+                    contender_client,
+                    ControllerConfig(),
+                    stop_event,
+                    cloud_factory=lambda region: AWSDriver(
+                        aws, aws, aws, **driver_kwargs
+                    ),
+                    block=True,
+                )
+
+            thread = threading.Thread(
+                target=election.run, args=(contender_client, run_fn, stop), daemon=True
+            )
+            thread.start()
+            return election, stop, thread
+
+        leader, leader_stop, leader_thread = contender("leader")
+        assert wait_until(leader.is_leader)
+        standby, standby_stop, standby_thread = contender("standby")
+
+        try:
+            # only the leader's manager reconciles
+            client.create("Service", make_lb_service())
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+            assert not standby.is_leader()
+
+            # leader goes away; standby must acquire and converge new work
+            leader_stop.set()
+            leader_thread.join(10)
+            assert wait_until(standby.is_leader, timeout=15.0)
+            lease = client.get("Lease", "default", "agac-ha")
+            assert lease.spec.holder_identity == "standby"
+
+            after_host = "after-0123456789abcdef.elb.us-west-2.amazonaws.com"
+            aws.add_load_balancer("after", NLB_REGION, after_host)
+            client.create("Service", make_lb_service(name="after", hostname=after_host))
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 2, timeout=15.0)
+        finally:
+            leader_stop.set()
+            standby_stop.set()
+            standby_thread.join(10)
+
+
+class TestApiserverOutageRecovery:
+    def test_informers_reconnect_after_apiserver_restart(self):
+        """The apiserver dies and comes back on the same endpoint: the
+        informers' list/watch loop must retry (1 s backoff), relist,
+        and resume reconciling without a controller restart."""
+        from agac_tpu.cluster import FakeCluster
+
+        state = FakeCluster()  # survives the apiserver restart, like etcd
+        first = TestApiServer(cluster=state).start()
+        port = int(first.url.rsplit(":", 1)[1])
+        client = RestClusterClient(first.url)
+        aws = FakeAWSBackend()
+        aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        stop = threading.Event()
+        try:
+            Manager(resync_period=0.5).run(
+                client,
+                ControllerConfig(),
+                stop,
+                cloud_factory=lambda region: AWSDriver(
+                    aws, aws, aws,
+                    poll_interval=0.01, poll_timeout=2.0,
+                    lb_not_active_retry=0.1, accelerator_missing_retry=0.1,
+                ),
+                block=False,
+            )
+            client.create("Service", make_lb_service())
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+
+            first.stop()  # outage begins; informers now fail and retry
+            time.sleep(1.5)
+
+            second = TestApiServer(cluster=state, port=port).start()
+            try:
+                during_host = "during-0123456789abcdef.elb.us-west-2.amazonaws.com"
+                aws.add_load_balancer("during", NLB_REGION, during_host)
+                client.create(
+                    "Service", make_lb_service(name="during", hostname=during_host)
+                )
+                assert wait_until(
+                    lambda: len(aws.all_accelerator_arns()) == 2, timeout=20.0
+                )
+            finally:
+                second.stop()
+        finally:
+            stop.set()
