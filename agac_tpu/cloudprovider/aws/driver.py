@@ -36,6 +36,7 @@ from typing import Callable, Optional
 from ... import apis, klog
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
 from .errors import (
+    ERR_ACCELERATOR_NOT_FOUND,
     AWSAPIError,
     EndpointGroupNotFoundException,
     ListenerNotFoundException,
@@ -680,17 +681,27 @@ class AWSDriver:
     def _list_related(
         self, arn: str
     ) -> tuple[Optional[Accelerator], Optional[Listener], Optional[EndpointGroup]]:
+        """The reference's ``listRelatedGlobalAccelerator``
+        (``global_accelerator.go:273-287``) treats EVERY error as "the
+        resource is gone", so a transient throttle during cleanup makes
+        the whole cleanup no-op "successfully" — the work item is
+        forgotten and the accelerator is orphaned forever (no later
+        event re-enqueues a deleted object).  Intent, not bug
+        (SURVEY.md §7): only the NotFound codes mean absence; anything
+        else propagates so the reconcile retries."""
         try:
             accelerator = self.ga.describe_accelerator(arn)
-        except Exception:
-            return None, None, None
+        except AWSAPIError as err:
+            if err.code == ERR_ACCELERATOR_NOT_FOUND:
+                return None, None, None
+            raise
         try:
             listener = self.get_listener(arn)
-        except Exception:
+        except ListenerNotFoundException:
             return accelerator, None, None
         try:
             endpoint_group = self.get_endpoint_group(listener.listener_arn)
-        except Exception:
+        except EndpointGroupNotFoundException:
             return accelerator, listener, None
         return accelerator, listener, endpoint_group
 
@@ -834,16 +845,50 @@ class AWSDriver:
             klog.infof(
                 "Finding record sets %r for HostedZone %s", owner_value, hosted_zone.id
             )
-            records = self.find_owned_a_record_sets(hosted_zone, owner_value)
+            record_sets = self._list_record_sets(hosted_zone.id)
+            owned_names = self._owned_record_names(record_sets, owner_value)
+            records = [
+                record_set
+                for record_set in record_sets
+                if record_set.name in owned_names and record_set.alias_target is not None
+            ]
             klog.v(4).infof("Finding A record %s in %r", hostname, records)
             record = find_a_record(records, hostname)
             if record is None:
                 klog.infof(
                     "Creating record for %s with %s", hostname, accelerator.accelerator_arn
                 )
-                self._create_metadata_record_set(hosted_zone, hostname, owner_value)
-                self._change_alias_record(
-                    hosted_zone, hostname, accelerator, CHANGE_ACTION_CREATE
+                # The reference creates the TXT then the A in two CREATE
+                # calls (``route53.go:101-113``); a failure between them
+                # strands a TXT that wedges every retry (CREATE of an
+                # existing record is InvalidChangeBatch).  Intent, not
+                # bug (SURVEY.md §7): submit both in ONE change batch —
+                # Route53 batches are atomic, so the pair commits or
+                # fails together.  A TXT we already own (stranded by an
+                # older torn write) is upserted WITH its existing values
+                # preserved (one TXT record set per name — co-owner
+                # values from other tools must survive); a foreign TXT
+                # still fails loudly rather than being clobbered.
+                existing_txt = next(
+                    (
+                        record_set
+                        for record_set in record_sets
+                        if record_set.type == RR_TYPE_TXT
+                        and replace_wildcards(record_set.name) == hostname + "."
+                    ),
+                    None,
+                )
+                txt_owned = existing_txt is not None and any(
+                    r.value == owner_value for r in existing_txt.resource_records
+                )
+                self._create_record_pair(
+                    hosted_zone,
+                    hostname,
+                    [r.value for r in existing_txt.resource_records]
+                    if txt_owned
+                    else [owner_value],
+                    accelerator,
+                    txt_action=CHANGE_ACTION_UPSERT if txt_owned else CHANGE_ACTION_CREATE,
                 )
                 created = True
             else:
@@ -883,6 +928,20 @@ class AWSDriver:
             if token is None:
                 return records
 
+    @staticmethod
+    def _owned_record_names(
+        record_sets: list[ResourceRecordSet], owner_value: str
+    ) -> set[str]:
+        """Names of record sets whose values include the owner value —
+        the ownership-matching rule shared by ensure and cleanup."""
+        owned = set()
+        for record_set in record_sets:
+            for record in record_set.resource_records:
+                if record.value == owner_value:
+                    klog.v(4).infof("Find owner txt record: %s", record_set.name)
+                    owned.add(record_set.name)
+        return owned
+
     def find_owned_a_record_sets(
         self, hosted_zone: HostedZone, owner_value: str
     ) -> list[ResourceRecordSet]:
@@ -890,13 +949,8 @@ class AWSDriver:
         own; return the alias record sets at those names (reference
         ``route53.go:216-238``)."""
         record_sets = self._list_record_sets(hosted_zone.id)
-        owned_names = []
-        for record_set in record_sets:
-            for record in record_set.resource_records:
-                if record.value == owner_value:
-                    klog.v(4).infof("Find owner txt record: %s", record_set.name)
-                    owned_names.append(record_set.name)
-        klog.v(4).infof("Finding A record %r", owned_names)
+        owned_names = self._owned_record_names(record_sets, owner_value)
+        klog.v(4).infof("Finding A record %r", sorted(owned_names))
         return [
             record_set
             for record_set in record_sets
@@ -913,21 +967,43 @@ class AWSDriver:
             if record.value == owner_value
         ]
 
-    def _create_metadata_record_set(
-        self, hosted_zone: HostedZone, hostname: str, owner_value: str
+    def _create_record_pair(
+        self,
+        hosted_zone: HostedZone,
+        hostname: str,
+        txt_values: list[str],
+        accelerator: Accelerator,
+        txt_action: str,
     ) -> None:
+        """TXT ownership record + A alias in one atomic change batch
+        (replaces the reference's two separate CREATE calls,
+        ``route53.go:240-289`` — see `_ensure_route53` for why).
+        ``txt_values`` is the full value set to write — on an UPSERT of
+        an existing owned TXT it carries the surviving co-owner values."""
         self.route53.change_resource_record_sets(
             hosted_zone.id,
             [
                 Change(
-                    CHANGE_ACTION_CREATE,
+                    txt_action,
                     ResourceRecordSet(
                         name=hostname,
                         type=RR_TYPE_TXT,
                         ttl=300,
-                        resource_records=[ResourceRecord(owner_value)],
+                        resource_records=[ResourceRecord(v) for v in txt_values],
                     ),
-                )
+                ),
+                Change(
+                    CHANGE_ACTION_CREATE,
+                    ResourceRecordSet(
+                        name=hostname,
+                        type=RR_TYPE_A,
+                        alias_target=AliasTarget(
+                            dns_name=accelerator.dns_name,
+                            evaluate_target_health=True,
+                            hosted_zone_id=GLOBAL_ACCELERATOR_HOSTED_ZONE_ID,
+                        ),
+                    ),
+                ),
             ],
         )
 

@@ -61,6 +61,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--queue-burst", type=int, default=100,
         help="Enqueue burst size per workqueue (token bucket capacity).",
     )
+    controller.add_argument(
+        "--queue-max-backoff", type=float, default=1000.0,
+        help="Cap on the per-item exponential retry backoff in seconds "
+        "(client-go's default 1000 is far past useful for external-API "
+        "retries; lower it to bound worst-case repair latency).",
+    )
 
     webhook = sub.add_parser("webhook", help="Start webhook server")
     webhook.add_argument(
@@ -123,7 +129,11 @@ def run_controller(args) -> int:
         return 1
 
     namespace = os.environ.get("POD_NAMESPACE") or "default"
-    queue_limits = {"queue_qps": args.queue_qps, "queue_burst": args.queue_burst}
+    queue_limits = {
+        "queue_qps": args.queue_qps,
+        "queue_burst": args.queue_burst,
+        "queue_max_backoff": args.queue_max_backoff,
+    }
     config = ControllerConfig(
         global_accelerator=GlobalAcceleratorConfig(
             workers=args.workers, cluster_name=args.cluster_name, **queue_limits

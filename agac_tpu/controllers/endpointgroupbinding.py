@@ -49,6 +49,8 @@ class EndpointGroupBindingConfig:
     workers: int = 1
     queue_qps: float = 10.0
     queue_burst: int = 100
+    # per-item exponential backoff cap (client-go default 1000 s)
+    queue_max_backoff: float = 1000.0
 
 
 class EndpointGroupBindingController:
@@ -64,7 +66,9 @@ class EndpointGroupBindingController:
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
         self.workqueue = RateLimitingQueue(
-            controller_rate_limiter(config.queue_qps, config.queue_burst), name=KIND
+            controller_rate_limiter(
+                config.queue_qps, config.queue_burst, config.queue_max_backoff
+            ), name=KIND
         )
 
         self.service_lister = informer_factory.informer("Service").lister()

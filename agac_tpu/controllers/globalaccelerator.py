@@ -49,6 +49,8 @@ class GlobalAcceleratorConfig:
     # burst); raise for large fleets — per-item backoff is unaffected
     queue_qps: float = 10.0
     queue_burst: int = 100
+    # per-item exponential backoff cap (client-go default 1000 s)
+    queue_max_backoff: float = 1000.0
 
 
 class GlobalAcceleratorController:
@@ -64,11 +66,15 @@ class GlobalAcceleratorController:
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
         self.service_queue = RateLimitingQueue(
-            controller_rate_limiter(config.queue_qps, config.queue_burst),
+            controller_rate_limiter(
+                config.queue_qps, config.queue_burst, config.queue_max_backoff
+            ),
             name=f"{CONTROLLER_AGENT_NAME}-service",
         )
         self.ingress_queue = RateLimitingQueue(
-            controller_rate_limiter(config.queue_qps, config.queue_burst),
+            controller_rate_limiter(
+                config.queue_qps, config.queue_burst, config.queue_max_backoff
+            ),
             name=f"{CONTROLLER_AGENT_NAME}-ingress",
         )
 

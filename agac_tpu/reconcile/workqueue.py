@@ -108,17 +108,22 @@ def default_controller_rate_limiter() -> MaxOfRateLimiter:
     return controller_rate_limiter(10.0, 100)
 
 
-def controller_rate_limiter(qps: float = 10.0, burst: int = 100) -> MaxOfRateLimiter:
+def controller_rate_limiter(
+    qps: float = 10.0, burst: int = 100, max_backoff: float = 1000.0
+) -> MaxOfRateLimiter:
     """The client-go default shape (per-item exponential + overall
     bucket) with a tunable bucket — the analog of passing a custom
     limiter where client-go users outgrow
     ``DefaultControllerRateLimiter()``'s 10 qps / 100 burst.
 
-    qps <= 0 means "no overall bucket" (per-item backoff only)."""
+    qps <= 0 means "no overall bucket" (per-item backoff only).
+    ``max_backoff`` caps the per-item exponential delay (client-go's
+    1000 s default is far past useful for external-API retries; many
+    controllers cap at seconds)."""
     if qps <= 0:
-        return MaxOfRateLimiter(ItemExponentialFailureRateLimiter(0.005, 1000.0))
+        return MaxOfRateLimiter(ItemExponentialFailureRateLimiter(0.005, max_backoff))
     return MaxOfRateLimiter(
-        ItemExponentialFailureRateLimiter(0.005, 1000.0),
+        ItemExponentialFailureRateLimiter(0.005, max_backoff),
         BucketRateLimiter(qps, burst),
     )
 

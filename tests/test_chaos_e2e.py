@@ -1,0 +1,279 @@
+"""Chaos e2e: randomized fault injection over a fleet, with workers > 1.
+
+The reference's correctness story is "every reconcile is safe to rerun
+at any time" (SURVEY.md §7 "convergence-by-requeue") — level-triggered
+idempotent reconciles plus rate-limited retries mean transient AWS
+failures only delay convergence.  `test_resilience_e2e.py` proves that
+for single, targeted faults; this suite proves it in the aggregate:
+
+- every AWS API call can fail with a retryable error, at random;
+- mutating calls can fail *after* committing (the ambiguous-timeout
+  shape: the SDK surfaces an error but the change took effect) — so
+  retries run against state the controller doesn't know it created;
+- multiple workers per controller reconcile a fleet concurrently.
+
+The fault source is a seeded RNG with a finite fault budget, so every
+run terminates: once the budget drains, remaining reconciles succeed.
+
+The no-duplicates test pins down the workqueue's same-key exclusion
+(client-go parity: a key being processed is deferred, not handed to a
+second worker — without it, two workers could both list-then-create
+and leave a duplicate accelerator).
+"""
+
+from __future__ import annotations
+
+import inspect
+import random
+import threading
+
+from agac_tpu import apis
+from agac_tpu.cloudprovider.aws.api import ELBv2API, GlobalAcceleratorAPI, Route53API
+from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+from agac_tpu.cloudprovider.aws.fake_backend import FakeAWSBackend
+from agac_tpu.cluster import FakeCluster
+from agac_tpu.controllers import (
+    EndpointGroupBindingConfig,
+    GlobalAcceleratorConfig,
+    Route53Config,
+)
+from agac_tpu.manager import ControllerConfig
+
+from .fixtures import NLB_REGION, make_alb_ingress, make_lb_service
+from .test_resilience_e2e import start_manager, wait_until
+
+# Every method the driver can reach — exactly the three API interfaces,
+# so test helpers (add_load_balancer, records_in_zone, ...) stay fault-free.
+API_OPS = frozenset(
+    name
+    for cls in (GlobalAcceleratorAPI, ELBv2API, Route53API)
+    for name, member in vars(cls).items()
+    if inspect.isfunction(member) and not name.startswith("_")
+)
+MUTATING_PREFIXES = ("create_", "update_", "delete_", "add_", "remove_", "tag_", "change_")
+
+
+class ChaosAWS(FakeAWSBackend):
+    """FakeAWSBackend where any API call may raise a retryable error.
+
+    ``fault_budget`` bounds total injected faults; ``p`` is the
+    per-call fault probability; for mutating ops, ``ambiguous`` is the
+    conditional probability that the fault fires *after* the real call
+    committed (timeout-after-commit)."""
+
+    def __init__(self, seed: int, fault_budget: int, p: float = 0.25, ambiguous: float = 0.4):
+        super().__init__()
+        self._rng = random.Random(seed)
+        self._chaos_lock = threading.Lock()
+        self.fault_budget = fault_budget
+        self._p = p
+        self._ambiguous = ambiguous
+        self.faults_served = 0
+        # the test's own assertion predicates read through the same
+        # API — only controller threads get faults
+        self._exempt_thread = threading.current_thread()
+
+    def refill(self, budget: int) -> None:
+        with self._chaos_lock:
+            self.fault_budget = budget
+
+    def _roll(self, op: str) -> str:
+        """Returns 'ok', 'fail', or 'commit-then-fail'."""
+        if threading.current_thread() is self._exempt_thread:
+            return "ok"
+        with self._chaos_lock:
+            if self.fault_budget <= 0 or self._rng.random() >= self._p:
+                return "ok"
+            self.fault_budget -= 1
+            self.faults_served += 1
+            if op.startswith(MUTATING_PREFIXES) and self._rng.random() < self._ambiguous:
+                return "commit-then-fail"
+            return "fail"
+
+    def __getattribute__(self, name):
+        attr = super().__getattribute__(name)
+        if name in API_OPS:
+            def chaotic(*args, **kwargs):
+                fate = self._roll(name)
+                if fate == "fail":
+                    raise AWSAPIError("ThrottlingException", f"chaos: {name}")
+                result = attr(*args, **kwargs)
+                if fate == "commit-then-fail":
+                    raise AWSAPIError("RequestTimeout", f"chaos after commit: {name}")
+                return result
+
+            return chaotic
+        return attr
+
+
+def nlb_hostname(i: int) -> str:
+    return f"lb{i}-0123456789abcdef.elb.{NLB_REGION}.amazonaws.com"
+
+
+def alb_hostname(i: int) -> str:
+    return f"k8s-default-chaos{i}-0a1b2c3d4e-111222333.{NLB_REGION}.elb.amazonaws.com"
+
+
+def fleet_config(workers: int) -> ControllerConfig:
+    # cap the per-item backoff: under heavy chaos an unlucky key can
+    # rack up 12+ failures, and 5ms * 2^12 ≈ 20 s would dominate the
+    # test clock without proving anything extra
+    return ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(
+            workers=workers, queue_max_backoff=0.25
+        ),
+        route53=Route53Config(workers=2, queue_max_backoff=0.25),
+        endpoint_group_binding=EndpointGroupBindingConfig(queue_max_backoff=0.25),
+    )
+
+
+def chain_complete(aws, owner: str, lb_hostname: str) -> bool:
+    """Accelerator with this owner tag exists, with exactly one
+    listener and one endpoint group whose endpoint is the owner's own
+    LB (cross-wired endpoints — svc0's group pointing at svc1's LB —
+    must fail the check)."""
+    from agac_tpu.cloudprovider.aws.load_balancer import get_lb_name_from_hostname
+
+    lb_name, _ = get_lb_name_from_hostname(lb_hostname)
+    lb_arn = aws.describe_load_balancers([lb_name])[0].load_balancer_arn
+    for arn in aws.all_accelerator_arns():
+        tags = {t.key: t.value for t in aws.list_tags_for_resource(arn)}
+        if tags.get("aws-global-accelerator-owner") != owner:
+            continue
+        listeners, _ = aws.list_listeners(arn, 100, None)
+        if len(listeners) != 1:
+            return False
+        groups, _ = aws.list_endpoint_groups(listeners[0].listener_arn, 100, None)
+        return len(groups) == 1 and [
+            d.endpoint_id for d in groups[0].endpoint_descriptions
+        ] == [lb_arn]
+    return False
+
+
+class TestChaosFleet:
+    def test_fleet_converges_through_chaos_then_cleans_up(self):
+        n_services, n_ingresses = 6, 2
+        cluster = FakeCluster()
+        aws = ChaosAWS(seed=20260729, fault_budget=50)
+        for i in range(n_services):
+            aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+        for i in range(n_ingresses):
+            aws.add_load_balancer(
+                f"k8s-default-chaos{i}-0a1b2c3d4e", NLB_REGION, alb_hostname(i)
+            )
+        zone = aws.add_hosted_zone("example.com")
+
+        # fleet: services 0-1 also carry route53 hostnames; one decoy
+        # unmanaged service must never get an accelerator
+        for i in range(n_services):
+            annotations = {}
+            if i < 2:
+                annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = f"app{i}.example.com"
+            cluster.create(
+                "Service",
+                make_lb_service(
+                    name=f"svc{i}",
+                    hostname=nlb_hostname(i),
+                    annotations=annotations,
+                ),
+            )
+        for i in range(n_ingresses):
+            cluster.create(
+                "Ingress",
+                make_alb_ingress(name=f"ing{i}", hostname=alb_hostname(i)),
+            )
+        cluster.create(
+            "Service", make_lb_service(name="decoy", managed=False, hostname=nlb_hostname(0))
+        )
+
+        stop = start_manager(cluster, aws, config=fleet_config(workers=3))
+        try:
+            owners = [f"service/default/svc{i}" for i in range(n_services)] + [
+                f"ingress/default/ing{i}" for i in range(n_ingresses)
+            ]
+
+            def all_converged():
+                if len(aws.all_accelerator_arns()) != n_services + n_ingresses:
+                    return False
+                for i, owner in enumerate(owners):
+                    lb = nlb_hostname(i) if i < n_services else alb_hostname(i - n_services)
+                    if not chain_complete(aws, owner, lb):
+                        return False
+                names = {(r.name, r.type) for r in aws.records_in_zone(zone.id)}
+                return names >= {
+                    ("app0.example.com.", "A"),
+                    ("app0.example.com.", "TXT"),
+                    ("app1.example.com.", "A"),
+                    ("app1.example.com.", "TXT"),
+                }
+
+            assert wait_until(all_converged, timeout=30.0)
+            assert aws.faults_served > 0, "chaos never fired — test is vacuous"
+
+            # phase 2: tear half the fleet down under a fresh fault budget
+            aws.refill(30)
+            for i in (2, 3):
+                svc = cluster.get("Service", "default", f"svc{i}")
+                del svc.metadata.annotations[
+                    apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+                ]
+                cluster.update("Service", svc)
+            # svc1 loses both annotations: accelerator AND records must go
+            svc = cluster.get("Service", "default", "svc1")
+            del svc.metadata.annotations[apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION]
+            del svc.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION]
+            cluster.update("Service", svc)
+            cluster.delete("Ingress", "default", "ing1")
+
+            survivors = {
+                "service/default/svc0",
+                "service/default/svc4",
+                "service/default/svc5",
+                "ingress/default/ing0",
+            }
+
+            def cleaned_up():
+                owners_now = set()
+                for arn in aws.all_accelerator_arns():
+                    tags = {t.key: t.value for t in aws.list_tags_for_resource(arn)}
+                    owners_now.add(tags.get("aws-global-accelerator-owner"))
+                if owners_now != survivors:
+                    return False
+                names = {(r.name, r.type) for r in aws.records_in_zone(zone.id)}
+                return ("app1.example.com.", "A") not in names and (
+                    "app0.example.com.",
+                    "A",
+                ) in names
+
+            assert wait_until(cleaned_up, timeout=30.0)
+            # survivors' chains are still intact (teardown touched nothing else)
+            assert chain_complete(aws, "service/default/svc0", nlb_hostname(0))
+            assert chain_complete(aws, "ingress/default/ing0", alb_hostname(0))
+        finally:
+            stop.set()
+
+    def test_concurrent_workers_create_no_duplicates(self):
+        """12 services, 4 workers, no faults: exactly one
+        CreateAccelerator per service — the workqueue's same-key
+        exclusion means no two workers ever race list-then-create for
+        one object."""
+        n = 12
+        cluster = FakeCluster()
+        aws = FakeAWSBackend()
+        for i in range(n):
+            aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+            cluster.create(
+                "Service", make_lb_service(name=f"svc{i}", hostname=nlb_hostname(i))
+            )
+
+        stop = start_manager(cluster, aws, config=fleet_config(workers=4))
+        try:
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == n, timeout=20.0)
+            # settle: resyncs/requeues must not mint duplicates either
+            assert not wait_until(
+                lambda: len(aws.all_accelerator_arns()) != n, timeout=0.5
+            )
+            creates = [c for c in aws.calls if c[0] == "CreateAccelerator"]
+            assert len(creates) == n
+        finally:
+            stop.set()

@@ -235,6 +235,31 @@ class TestCleanup:
     def test_cleanup_of_missing_accelerator_is_noop(self, backend, driver):
         driver.cleanup_global_accelerator("arn:aws:globalaccelerator::123:accelerator/nope")
 
+    def test_cleanup_raises_on_transient_describe_error(self, backend, driver):
+        """A throttle during cleanup discovery must propagate so the
+        reconcile retries — the reference's listRelatedGlobalAccelerator
+        treats any error as "gone" and silently orphans the chain
+        (``global_accelerator.go:273-287``; fixed here by intent,
+        SURVEY.md §7)."""
+        svc = make_lb_service()
+        arn, _, _ = ensure_service(driver, svc)
+
+        original = backend.describe_accelerator
+
+        def throttled(target_arn):
+            if target_arn == arn:
+                raise AWSAPIError("ThrottlingException", "Rate exceeded")
+            return original(target_arn)
+
+        backend.describe_accelerator = throttled
+        with pytest.raises(AWSAPIError):
+            driver.cleanup_global_accelerator(arn)
+        # nothing was deleted and nothing was silently "succeeded"
+        assert backend.all_accelerator_arns() == [arn]
+        backend.describe_accelerator = original
+        driver.cleanup_global_accelerator(arn)
+        assert backend.all_accelerator_arns() == []
+
 
 class TestDiscovery:
     def test_list_by_resource_and_hostname(self, backend, driver):
@@ -289,6 +314,134 @@ class TestRoute53:
         created, _ = driver.ensure_route53_for_service(svc, lbi, hostnames, "default")
         assert not created
         assert sum(1 for c in backend.calls if c[0] == "ChangeResourceRecordSets") == n_changes
+
+    def test_create_pair_is_one_atomic_batch(self, backend, driver, with_accelerator):
+        """TXT + A are submitted in a single change batch (atomic in
+        Route53), so a failure between them can never strand a TXT that
+        wedges retries — unlike the reference's two CREATE calls
+        (``route53.go:101-113``)."""
+        svc, arn, zone = with_accelerator
+        before = sum(1 for c in backend.calls if c[0] == "ChangeResourceRecordSets")
+        created, _ = driver.ensure_route53_for_service(
+            svc, svc.status.load_balancer.ingress[0], ["app.example.com"], "default"
+        )
+        assert created
+        assert (
+            sum(1 for c in backend.calls if c[0] == "ChangeResourceRecordSets")
+            == before + 1
+        )
+
+    def test_repairs_stranded_owned_txt(self, backend, driver, with_accelerator):
+        """An owned TXT with no A record (torn state left by an older
+        build or an ambiguous API timeout) is upserted, not re-CREATEd:
+        the ensure converges instead of failing forever on
+        InvalidChangeBatch."""
+        from agac_tpu.cloudprovider.aws.types import (
+            Change,
+            ResourceRecord,
+            ResourceRecordSet,
+        )
+
+        svc, arn, zone = with_accelerator
+        backend.change_resource_record_sets(
+            zone.id,
+            [
+                Change(
+                    "CREATE",
+                    ResourceRecordSet(
+                        name="app.example.com",
+                        type="TXT",
+                        ttl=300,
+                        resource_records=[
+                            ResourceRecord(
+                                Route53OwnerValue("default", "service", "default", "web")
+                            )
+                        ],
+                    ),
+                )
+            ],
+        )
+        created, retry = driver.ensure_route53_for_service(
+            svc, svc.status.load_balancer.ingress[0], ["app.example.com"], "default"
+        )
+        assert created and retry == 0
+        names = {(r.name, r.type) for r in backend.records_in_zone(zone.id)}
+        assert names == {("app.example.com.", "TXT"), ("app.example.com.", "A")}
+
+    def test_repair_preserves_co_owner_txt_values(self, backend, driver, with_accelerator):
+        """Route53 allows one TXT record set per name, so co-managing
+        tools share it as multiple values.  The torn-state repair must
+        UPSERT the union, not just our owner value."""
+        from agac_tpu.cloudprovider.aws.types import (
+            Change,
+            ResourceRecord,
+            ResourceRecordSet,
+        )
+
+        svc, arn, zone = with_accelerator
+        ours = Route53OwnerValue("default", "service", "default", "web")
+        theirs = '"heritage=external-dns,external-dns/owner=other"'
+        backend.change_resource_record_sets(
+            zone.id,
+            [
+                Change(
+                    "CREATE",
+                    ResourceRecordSet(
+                        name="app.example.com",
+                        type="TXT",
+                        ttl=300,
+                        resource_records=[ResourceRecord(theirs), ResourceRecord(ours)],
+                    ),
+                )
+            ],
+        )
+        created, _ = driver.ensure_route53_for_service(
+            svc, svc.status.load_balancer.ingress[0], ["app.example.com"], "default"
+        )
+        assert created
+        records = {(r.name, r.type): r for r in backend.records_in_zone(zone.id)}
+        txt_values = {r.value for r in records[("app.example.com.", "TXT")].resource_records}
+        assert txt_values == {ours, theirs}
+        assert ("app.example.com.", "A") in records
+
+    def test_foreign_txt_fails_loudly(self, backend, driver, with_accelerator):
+        """A TXT at the hostname owned by someone else must NOT be
+        clobbered — the ensure fails (and retries) like the reference's
+        CREATE would."""
+        from agac_tpu.cloudprovider.aws.types import (
+            Change,
+            ResourceRecord,
+            ResourceRecordSet,
+        )
+
+        svc, arn, zone = with_accelerator
+        backend.change_resource_record_sets(
+            zone.id,
+            [
+                Change(
+                    "CREATE",
+                    ResourceRecordSet(
+                        name="app.example.com",
+                        type="TXT",
+                        ttl=300,
+                        resource_records=[
+                            ResourceRecord(
+                                Route53OwnerValue("other-cluster", "service", "default", "web")
+                            )
+                        ],
+                    ),
+                )
+            ],
+        )
+        with pytest.raises(AWSAPIError):
+            driver.ensure_route53_for_service(
+                svc, svc.status.load_balancer.ingress[0], ["app.example.com"], "default"
+            )
+        # foreign TXT untouched, no A record snuck in
+        records = {(r.name, r.type): r for r in backend.records_in_zone(zone.id)}
+        assert ("app.example.com.", "A") not in records
+        txt = records[("app.example.com.", "TXT")]
+        assert "other-cluster" in txt.resource_records[0].value
 
     def test_wildcard_hostname(self, backend, driver, with_accelerator):
         svc, arn, zone = with_accelerator

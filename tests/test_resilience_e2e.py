@@ -43,7 +43,7 @@ def wait_until(pred, timeout=POLL_TIMEOUT, interval=0.02):
     return pred()
 
 
-def start_manager(cluster, aws, **driver_kwargs):
+def start_manager(cluster, aws, config=None, **driver_kwargs):
     """One controller 'process': returns its stop event."""
     stop = threading.Event()
     kwargs = dict(
@@ -55,7 +55,7 @@ def start_manager(cluster, aws, **driver_kwargs):
     kwargs.update(driver_kwargs)
     Manager(resync_period=0.3).run(
         cluster,
-        ControllerConfig(),
+        config or ControllerConfig(),
         stop,
         cloud_factory=lambda region: AWSDriver(aws, aws, aws, **kwargs),
         block=False,
