@@ -846,12 +846,7 @@ class AWSDriver:
                 "Finding record sets %r for HostedZone %s", owner_value, hosted_zone.id
             )
             record_sets = self._list_record_sets(hosted_zone.id)
-            owned_names = self._owned_record_names(record_sets, owner_value)
-            records = [
-                record_set
-                for record_set in record_sets
-                if record_set.name in owned_names and record_set.alias_target is not None
-            ]
+            records = self._owned_alias_record_sets(record_sets, owner_value)
             klog.v(4).infof("Finding A record %s in %r", hostname, records)
             record = find_a_record(records, hostname)
             if record is None:
@@ -942,20 +937,26 @@ class AWSDriver:
                     owned.add(record_set.name)
         return owned
 
-    def find_owned_a_record_sets(
-        self, hosted_zone: HostedZone, owner_value: str
+    @classmethod
+    def _owned_alias_record_sets(
+        cls, record_sets: list[ResourceRecordSet], owner_value: str
     ) -> list[ResourceRecordSet]:
-        """TXT records holding the owner value name the hostnames we
-        own; return the alias record sets at those names (reference
-        ``route53.go:216-238``)."""
-        record_sets = self._list_record_sets(hosted_zone.id)
-        owned_names = self._owned_record_names(record_sets, owner_value)
-        klog.v(4).infof("Finding A record %r", sorted(owned_names))
+        """Alias record sets at names whose TXT values include the
+        owner value — the ownership rule shared by ensure and cleanup
+        (reference ``route53.go:216-238``)."""
+        owned_names = cls._owned_record_names(record_sets, owner_value)
         return [
             record_set
             for record_set in record_sets
             if record_set.name in owned_names and record_set.alias_target is not None
         ]
+
+    def find_owned_a_record_sets(
+        self, hosted_zone: HostedZone, owner_value: str
+    ) -> list[ResourceRecordSet]:
+        return self._owned_alias_record_sets(
+            self._list_record_sets(hosted_zone.id), owner_value
+        )
 
     def _find_owned_metadata_record_sets(
         self, hosted_zone: HostedZone, owner_value: str

@@ -252,6 +252,107 @@ class TestChaosFleet:
         finally:
             stop.set()
 
+    def test_endpoint_group_binding_lifecycle_through_chaos(self):
+        """The CRD's finalizer state machine (bind → weight sync →
+        unbind → finalizer clear) converges through random AWS faults:
+        status/finalizer updates and endpoint membership stay
+        consistent because every step re-reads both sides and retries."""
+        from agac_tpu.apis.endpointgroupbinding import (
+            FINALIZER,
+            EndpointGroupBinding,
+            EndpointGroupBindingSpec,
+            ServiceReference,
+        )
+        from agac_tpu.cloudprovider.aws import AWSDriver
+        from agac_tpu.cluster import ObjectMeta
+        from agac_tpu.errors import NotFoundError
+
+        cluster = FakeCluster()
+        aws = ChaosAWS(seed=77, fault_budget=25)
+        aws.add_load_balancer("lb0", NLB_REGION, nlb_hostname(0))
+        aws.add_load_balancer("bound", NLB_REGION, nlb_hostname(1).replace("lb1", "bound"))
+
+        # the endpoint group the CRD binds into, created out-of-band
+        # (main thread is chaos-exempt, mirroring "it already existed")
+        driver = AWSDriver(aws, aws, aws)
+        svc = make_lb_service(name="anchor", hostname=nlb_hostname(0))
+        arn, _, _ = driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "other", "lb0", NLB_REGION
+        )
+        endpoint_group = driver.get_endpoint_group(driver.get_listener(arn).listener_arn)
+
+        cluster.create(
+            "Service",
+            make_lb_service(
+                name="bound",
+                managed=False,
+                hostname=nlb_hostname(1).replace("lb1", "bound"),
+            ),
+        )
+        cluster.create(
+            "EndpointGroupBinding",
+            EndpointGroupBinding(
+                metadata=ObjectMeta(name="binding", namespace="default"),
+                spec=EndpointGroupBindingSpec(
+                    endpoint_group_arn=endpoint_group.endpoint_group_arn,
+                    weight=100,
+                    service_ref=ServiceReference(name="bound"),
+                ),
+            ),
+        )
+        stop = start_manager(cluster, aws, config=fleet_config(workers=2))
+        try:
+            def bound():
+                try:
+                    obj = cluster.get("EndpointGroupBinding", "default", "binding")
+                except NotFoundError:
+                    return False
+                if obj.metadata.finalizers != [FINALIZER] or len(obj.status.endpoint_ids) != 1:
+                    return False
+                described = aws.describe_endpoint_group(endpoint_group.endpoint_group_arn)
+                weights = {d.endpoint_id: d.weight for d in described.endpoint_descriptions}
+                return weights.get(obj.status.endpoint_ids[0]) == 100
+
+            assert wait_until(bound, timeout=30.0)
+            assert aws.faults_served > 0, "chaos never fired — test is vacuous"
+
+            # weight change propagates under a fresh fault budget
+            aws.refill(10)
+            obj = cluster.get("EndpointGroupBinding", "default", "binding")
+            bound_id = obj.status.endpoint_ids[0]
+            obj.spec.weight = 7
+            cluster.update("EndpointGroupBinding", obj)
+            assert wait_until(
+                lambda: any(
+                    d.weight == 7
+                    for d in aws.describe_endpoint_group(
+                        endpoint_group.endpoint_group_arn
+                    ).endpoint_descriptions
+                ),
+                timeout=30.0,
+            )
+
+            # delete under chaos: endpoint unbound, finalizer cleared
+            aws.refill(10)
+            cluster.delete("EndpointGroupBinding", "default", "binding")
+
+            def gone():
+                try:
+                    cluster.get("EndpointGroupBinding", "default", "binding")
+                    return False
+                except NotFoundError:
+                    pass
+                described = aws.describe_endpoint_group(endpoint_group.endpoint_group_arn)
+                return bound_id not in [
+                    d.endpoint_id for d in described.endpoint_descriptions
+                ]
+
+            assert wait_until(gone, timeout=30.0)
+            # the anchor chain the group belongs to is untouched
+            assert len(aws.all_accelerator_arns()) == 1
+        finally:
+            stop.set()
+
     def test_concurrent_workers_create_no_duplicates(self):
         """12 services, 4 workers, no faults: exactly one
         CreateAccelerator per service — the workqueue's same-key
