@@ -21,12 +21,17 @@ against fake and real backends.
 from __future__ import annotations
 
 import json
+import random
+import time
+import uuid
 import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass
 from typing import Callable, Optional
+
+from ... import klog
 
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
 from .errors import (
@@ -70,6 +75,63 @@ def _default_transport(method, url, headers, body, timeout) -> tuple[int, bytes]
         return err.code, err.read()
 
 
+# The aws-sdk-go-v2 clients the reference constructs retry transiently
+# failed calls before the error ever reaches the reconcile loop
+# ("standard" retry mode: 3 attempts, exponential backoff with full
+# jitter).  Same semantics here, at the one choke point every wire
+# protocol shares.
+RETRY_ATTEMPTS = 3
+RETRY_BASE_DELAY = 0.2
+RETRY_MAX_DELAY = 5.0
+_RETRYABLE_STATUSES = {429, 500, 502, 503, 504}
+# service error codes retryable regardless of HTTP status — the SDK's
+# transient/throttling taxonomy (GA/ELBv2 throttles arrive as 400s;
+# PriorRequestNotComplete is Route53's).  Compared EXACTLY against the
+# parsed service code, never substring-matched against the body (an
+# InvalidChangeBatch message echoing a record value that happens to
+# contain "Throttling" must not be retried).
+RETRYABLE_CODES = frozenset(
+    {
+        "Throttling",
+        "ThrottlingException",
+        "ThrottledException",
+        "TooManyRequestsException",
+        "RequestThrottled",
+        "RequestThrottledException",
+        "RequestLimitExceeded",
+        "SlowDown",
+        "ServiceUnavailable",
+        "ServiceUnavailableException",
+        "RequestTimeout",
+        "RequestTimeoutException",
+        "PriorRequestNotComplete",
+        "TransientFailure",
+        "InternalFailure",
+        "InternalServiceError",
+        "InternalServiceErrorException",
+    }
+)
+
+
+def _ga_error_code(body: bytes) -> str:
+    """Service code from an AWS JSON-1.1 error body (``__type``)."""
+    try:
+        payload = json.loads(body)
+        raw = payload.get("__type") or payload.get("code") or ""
+        return raw.split("#")[-1]
+    except Exception:
+        return ""
+
+
+def _xml_error_code(body: bytes) -> str:
+    """Service code from a Query/REST-XML error body (``<Code>``)."""
+    try:
+        root = xml_strip_ns(ET.fromstring(body))
+        return root.findtext(".//Code") or ""
+    except ET.ParseError:
+        return ""
+
+
 class _SignedClient:
     def __init__(
         self,
@@ -79,6 +141,9 @@ class _SignedClient:
         credentials=None,
         transport: Optional[Transport] = None,
         timeout: float = 30.0,
+        attempts: int = RETRY_ATTEMPTS,
+        sleep: Optional[Callable[[float], None]] = None,
+        error_code_parser: Callable[[bytes], str] = _xml_error_code,
     ):
         self.service = service
         self.region = region
@@ -91,17 +156,55 @@ class _SignedClient:
             self._provider = credentials
         self._transport = transport or _default_transport
         self._timeout = timeout
+        self._attempts = max(1, attempts)
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._error_code = error_code_parser
+
+    def _retryable(self, status: int, body: bytes) -> bool:
+        if status in _RETRYABLE_STATUSES:
+            return True
+        return status >= 400 and self._error_code(body) in RETRYABLE_CODES
 
     def request(
         self, method: str, path: str, headers: dict[str, str], body: bytes
     ) -> tuple[int, bytes]:
         url = f"{self.endpoint}{path}"
-        # per-request credential fetch: the provider refreshes expiring
-        # session credentials (IRSA) transparently
-        signed = sign_request(
-            method, url, headers, body, self.service, self.region, self._provider.get()
+        last_exc: Optional[Exception] = None
+        for attempt in range(self._attempts):
+            if attempt:
+                # full jitter keeps a fleet of workers from thundering
+                self._sleep(
+                    random.uniform(
+                        0, min(RETRY_MAX_DELAY, RETRY_BASE_DELAY * (2 ** attempt))
+                    )
+                )
+            # re-sign every attempt: fresh timestamp, and the provider
+            # refreshes expiring session credentials (IRSA) transparently
+            signed = sign_request(
+                method, url, headers, body, self.service, self.region,
+                self._provider.get(),
+            )
+            try:
+                status, payload = self._transport(
+                    method, url, signed, body or None, self._timeout
+                )
+            except (urllib.error.URLError, OSError) as err:
+                # connection refused/reset, DNS failure, socket timeout.
+                # Safe to re-send even for creates: the GA create calls
+                # carry an IdempotencyToken (below), UpsertRecord/tag
+                # calls are idempotent, and everything else is a read.
+                last_exc = err
+                continue
+            if self._retryable(status, payload) and attempt + 1 < self._attempts:
+                klog.v(2).infof(
+                    "retrying %s %s after HTTP %d (attempt %d/%d)",
+                    method, path, status, attempt + 1, self._attempts,
+                )
+                continue
+            return status, payload
+        raise AWSAPIError(
+            "RequestError", f"{method} {url} failed after {self._attempts} attempts: {last_exc}"
         )
-        return self._transport(method, url, signed, body or None, self._timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -110,11 +213,9 @@ class _SignedClient:
 
 
 def _ga_error(status: int, body: bytes) -> AWSAPIError:
-    code, message = "UnknownError", ""
+    code = _ga_error_code(body) or "UnknownError"
     try:
         payload = json.loads(body)
-        raw = payload.get("__type") or payload.get("code") or ""
-        code = raw.split("#")[-1] or code
         message = payload.get("message") or payload.get("Message") or ""
     except Exception:
         message = body[:200].decode(errors="replace")
@@ -179,13 +280,19 @@ def _endpoint_configurations_json(configs: list[EndpointConfiguration]) -> list[
 
 
 class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
-    def __init__(self, credentials=None, transport=None, endpoint=None):
+    def __init__(
+        self, credentials=None, transport=None, endpoint=None,
+        attempts=RETRY_ATTEMPTS, sleep=None,
+    ):
         self._client = _SignedClient(
             "globalaccelerator",
             GA_ENDPOINT_REGION,
             endpoint or f"https://globalaccelerator.{GA_ENDPOINT_REGION}.amazonaws.com",
             credentials,
             transport,
+            attempts=attempts,
+            sleep=sleep,
+            error_code_parser=_ga_error_code,
         )
 
     def _call(self, operation: str, payload: dict) -> dict:
@@ -226,6 +333,11 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
                 "IpAddressType": ip_address_type,
                 "Enabled": enabled,
                 "Tags": [{"Key": t.key, "Value": t.value} for t in tags],
+                # one token per logical create, shared by retries: a
+                # re-sent request after a timeout-after-commit returns
+                # the original resource instead of minting a duplicate
+                # (the SDK auto-fills this field for the reference)
+                "IdempotencyToken": uuid.uuid4().hex,
             },
         )
         return _accelerator_from_json(data.get("Accelerator", {}))
@@ -276,6 +388,7 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
                 ],
                 "Protocol": protocol,
                 "ClientAffinity": client_affinity,
+                "IdempotencyToken": uuid.uuid4().hex,
             },
         )
         return _listener_from_json(data.get("Listener", {}))
@@ -321,6 +434,7 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
                 "EndpointConfigurations": _endpoint_configurations_json(
                     endpoint_configurations
                 ),
+                "IdempotencyToken": uuid.uuid4().hex,
             },
         )
         return _endpoint_group_from_json(data.get("EndpointGroup", {}))
@@ -379,23 +493,30 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
 
 
 def _xml_error(status: int, body: bytes) -> AWSAPIError:
+    code = _xml_error_code(body)
+    if not code and not body.strip().startswith(b"<"):
+        return AWSAPIError("UnknownError", body[:200].decode(errors="replace"))
     try:
         root = xml_strip_ns(ET.fromstring(body))
-        code = root.findtext(".//Code") or "UnknownError"
         message = root.findtext(".//Message") or ""
-        return AWSAPIError(code, message)
     except ET.ParseError:
-        return AWSAPIError("UnknownError", body[:200].decode(errors="replace"))
+        message = body[:200].decode(errors="replace")
+    return AWSAPIError(code or "UnknownError", message)
 
 
 class RealELBv2API(ELBv2API):
-    def __init__(self, region: str, credentials=None, transport=None, endpoint=None):
+    def __init__(
+        self, region: str, credentials=None, transport=None, endpoint=None,
+        attempts=RETRY_ATTEMPTS, sleep=None,
+    ):
         self._client = _SignedClient(
             "elasticloadbalancing",
             region,
             endpoint or f"https://elasticloadbalancing.{region}.amazonaws.com",
             credentials,
             transport,
+            attempts=attempts,
+            sleep=sleep,
         )
 
     def describe_load_balancers(self, names):
@@ -480,7 +601,10 @@ def _record_set_from_xml(element: ET.Element) -> ResourceRecordSet:
 
 
 class RealRoute53API(Route53API):
-    def __init__(self, credentials=None, transport=None, endpoint=None):
+    def __init__(
+        self, credentials=None, transport=None, endpoint=None,
+        attempts=RETRY_ATTEMPTS, sleep=None,
+    ):
         # Route53 is global; requests are signed against us-east-1
         self._client = _SignedClient(
             "route53",
@@ -488,6 +612,8 @@ class RealRoute53API(Route53API):
             endpoint or "https://route53.amazonaws.com",
             credentials,
             transport,
+            attempts=attempts,
+            sleep=sleep,
         )
 
     def _get(self, path: str) -> ET.Element:

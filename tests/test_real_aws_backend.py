@@ -135,6 +135,7 @@ class TestGlobalAcceleratorProtocol:
         stub.queue(200, {"Accelerator": {"AcceleratorArn": "arn:new"}})
         client.create_accelerator("name", "IPV4", True, [Tag("k", "v")])
         payload = json.loads(stub.requests[0][3])
+        assert payload.pop("IdempotencyToken")
         assert payload == {
             "Name": "name",
             "IpAddressType": "IPV4",
@@ -184,6 +185,128 @@ class TestGlobalAcceleratorProtocol:
         with pytest.raises(AWSAPIError) as exc:
             client.describe_accelerator("arn:a")
         assert exc.value.code == "AccessDeniedException"
+
+
+class TestStandardRetryMode:
+    """The SDK-level retry the reference inherits from aws-sdk-go-v2:
+    throttles, 5xx and connection failures are retried with backoff
+    before the error ever reaches the reconcile loop."""
+
+    def make(self):
+        stub = StubTransport()
+        self.sleeps = []
+        api = RealGlobalAcceleratorAPI(
+            credentials=CREDS, transport=stub, sleep=self.sleeps.append
+        )
+        return api, stub
+
+    def test_5xx_retried_until_success(self):
+        client, stub = self.make()
+        stub.queue(503, b"Service Unavailable")
+        stub.queue(500, b"oops")
+        stub.queue(200, {"Accelerators": []})
+        accelerators, token = client.list_accelerators(100, None)
+        assert accelerators == [] and token is None
+        assert len(stub.requests) == 3
+        # jittered exponential backoff between attempts
+        assert len(self.sleeps) == 2 and all(s >= 0 for s in self.sleeps)
+
+    def test_throttle_code_on_400_retried(self):
+        client, stub = self.make()
+        stub.queue(400, {"__type": "ThrottlingException", "message": "Rate exceeded"})
+        stub.queue(200, {"Accelerators": []})
+        accelerators, _ = client.list_accelerators(100, None)
+        assert accelerators == []
+        assert len(stub.requests) == 2
+
+    def test_retries_exhausted_surfaces_last_error(self):
+        client, stub = self.make()
+        for _ in range(3):
+            stub.queue(400, {"__type": "ThrottlingException", "message": "Rate exceeded"})
+        with pytest.raises(AWSAPIError) as exc:
+            client.list_accelerators(100, None)
+        assert exc.value.code == "ThrottlingException"
+        assert len(stub.requests) == 3
+
+    def test_non_retryable_4xx_fails_immediately(self):
+        client, stub = self.make()
+        stub.queue(400, {"__type": "AccessDeniedException", "message": "no"})
+        with pytest.raises(AWSAPIError) as exc:
+            client.describe_accelerator("arn:a")
+        assert exc.value.code == "AccessDeniedException"
+        assert len(stub.requests) == 1
+
+    def test_connection_errors_retried_then_raise(self):
+        import urllib.error
+
+        calls = []
+
+        def flaky(method, url, headers, body, timeout):
+            calls.append(url)
+            if len(calls) < 3:
+                raise urllib.error.URLError("connection refused")
+            return 200, json.dumps({"Accelerators": []}).encode()
+
+        client = RealGlobalAcceleratorAPI(
+            credentials=CREDS, transport=flaky, sleep=lambda s: None
+        )
+        accelerators, _ = client.list_accelerators(100, None)
+        assert accelerators == [] and len(calls) == 3
+
+        calls.clear()
+
+        def dead(method, url, headers, body, timeout):
+            calls.append(url)
+            raise urllib.error.URLError("connection refused")
+
+        client = RealGlobalAcceleratorAPI(
+            credentials=CREDS, transport=dead, sleep=lambda s: None
+        )
+        with pytest.raises(AWSAPIError) as exc:
+            client.list_accelerators(100, None)
+        assert exc.value.code == "RequestError"
+        assert len(calls) == 3
+
+    def test_message_echoing_throttle_word_not_retried(self):
+        """Retryability is decided on the PARSED service code, never by
+        substring-matching the body: a permanent validation error whose
+        message merely mentions 'Throttling' fails immediately."""
+        client, stub = self.make()
+        stub.queue(
+            400,
+            {
+                "__type": "ValidationException",
+                "message": "tag value 'ThrottlingException-notes' is invalid",
+            },
+        )
+        with pytest.raises(AWSAPIError) as exc:
+            client.describe_accelerator("arn:a")
+        assert exc.value.code == "ValidationException"
+        assert len(stub.requests) == 1
+
+    def test_create_calls_carry_idempotency_token(self):
+        """Connection-error re-sends of the GA creates are
+        duplicate-safe because every create carries an IdempotencyToken
+        (the SDK auto-fills this field for the reference)."""
+        client, stub = self.make()
+        stub.queue(200, {"Accelerator": {"AcceleratorArn": "arn:a"}})
+        stub.queue(200, {"Listener": {"ListenerArn": "arn:l"}})
+        stub.queue(200, {"EndpointGroup": {"EndpointGroupArn": "arn:eg"}})
+        client.create_accelerator("n", "IPV4", True, [])
+        client.create_listener("arn:a", [PortRange(80, 80)], "TCP", "NONE")
+        client.create_endpoint_group("arn:l", "us-west-2", [])
+        tokens = [
+            json.loads(body)["IdempotencyToken"] for _, _, _, body in stub.requests
+        ]
+        assert all(tokens) and len(set(tokens)) == 3
+
+    def test_each_attempt_is_resigned(self):
+        client, stub = self.make()
+        stub.queue(503, b"")
+        stub.queue(200, {"Accelerators": []})
+        client.list_accelerators(100, None)
+        auth = [headers["Authorization"] for _, _, headers, _ in stub.requests]
+        assert len(auth) == 2 and all(a.startswith("AWS4-HMAC-SHA256") for a in auth)
 
 
 class TestELBv2Protocol:
