@@ -190,12 +190,19 @@ class _SignedClient:
                 )
             except (urllib.error.URLError, OSError) as err:
                 # connection refused/reset, DNS failure, socket timeout.
-                # Safe to re-send even for creates: the GA create calls
-                # carry an IdempotencyToken (below), UpsertRecord/tag
-                # calls are idempotent, and everything else is a read.
+                # Re-sending after a possible commit is safe everywhere:
+                # GA creates carry an IdempotencyToken (below); updates,
+                # tag merges and record UPSERTs are idempotent; deletes
+                # re-sent after a commit surface NotFound, which every
+                # driver path already treats as absence; the rest are
+                # reads.
                 last_exc = err
+                klog.v(2).infof(
+                    "retrying %s %s after connection error (%s, attempt %d/%d)",
+                    method, path, err, attempt + 1, self._attempts,
+                )
                 continue
-            if self._retryable(status, payload) and attempt + 1 < self._attempts:
+            if attempt + 1 < self._attempts and self._retryable(status, payload):
                 klog.v(2).infof(
                     "retrying %s %s after HTTP %d (attempt %d/%d)",
                     method, path, status, attempt + 1, self._attempts,
@@ -493,15 +500,13 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
 
 
 def _xml_error(status: int, body: bytes) -> AWSAPIError:
-    code = _xml_error_code(body)
-    if not code and not body.strip().startswith(b"<"):
-        return AWSAPIError("UnknownError", body[:200].decode(errors="replace"))
     try:
         root = xml_strip_ns(ET.fromstring(body))
-        message = root.findtext(".//Message") or ""
     except ET.ParseError:
-        message = body[:200].decode(errors="replace")
-    return AWSAPIError(code or "UnknownError", message)
+        return AWSAPIError("UnknownError", body[:200].decode(errors="replace"))
+    return AWSAPIError(
+        root.findtext(".//Code") or "UnknownError", root.findtext(".//Message") or ""
+    )
 
 
 class RealELBv2API(ELBv2API):

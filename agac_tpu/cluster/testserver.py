@@ -225,9 +225,15 @@ class _Handler(BaseHTTPRequestHandler):
         timeout_seconds = float(query.get("timeoutSeconds", 240))
         deadline = time.monotonic() + timeout_seconds
         stopped = threading.Event()
+        start_generation = getattr(self.server, "watch_generation", 0)
+
+        def broken() -> bool:
+            return getattr(self.server, "watch_generation", 0) != start_generation
 
         def stop() -> bool:
-            return stopped.is_set() or time.monotonic() >= deadline
+            return (
+                stopped.is_set() or time.monotonic() >= deadline or broken()
+            )
 
         self._send(200, b"", chunked=True)
         try:
@@ -235,6 +241,17 @@ class _Handler(BaseHTTPRequestHandler):
                 line = (
                     json.dumps(
                         {"type": event.type, "object": _full_wire(kind, event.obj)}
+                    ).encode()
+                    + b"\n"
+                )
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                self.wfile.flush()
+            if broken():
+                # the apiserver expired this watch: emit the 410 ERROR
+                # event clients must answer with a fresh list+watch
+                line = (
+                    json.dumps(
+                        {"type": "ERROR", "object": {"code": 410, "reason": "Gone"}}
                     ).encode()
                     + b"\n"
                 )
@@ -322,6 +339,14 @@ class TestApiServer:
         """Route CREATE/UPDATE admission for ``kind`` through the
         webhook at ``url`` (the ValidatingWebhookConfiguration analog)."""
         self._httpd.webhooks[kind] = url  # type: ignore[attr-defined]
+
+    def break_watches(self) -> None:
+        """Expire every active watch stream with a 410 Gone ERROR
+        event — the compaction/timeout fault real apiservers serve,
+        which clients must answer with a fresh list+watch."""
+        self._httpd.watch_generation = (  # type: ignore[attr-defined]
+            getattr(self._httpd, "watch_generation", 0) + 1
+        )
 
     @property
     def url(self) -> str:

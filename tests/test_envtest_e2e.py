@@ -245,6 +245,45 @@ class TestLeaderFailoverOverHTTP:
             standby_thread.join(10)
 
 
+class TestWatchExpiry:
+    def test_410_gone_triggers_relist_and_no_events_lost(self, server):
+        """The apiserver expires every active watch mid-stream (the
+        compaction/timeout fault real apiservers serve as a 410 ERROR
+        event): informers must answer with a fresh list+watch and pick
+        up objects created while no stream was up."""
+        client = RestClusterClient(server.url)
+        aws = FakeAWSBackend()
+        aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        stop = threading.Event()
+        try:
+            Manager(resync_period=300).run(  # no resync: relist must do it
+                client,
+                ControllerConfig(),
+                stop,
+                cloud_factory=lambda region: AWSDriver(
+                    aws, aws, aws,
+                    poll_interval=0.01, poll_timeout=2.0,
+                    lb_not_active_retry=0.1, accelerator_missing_retry=0.1,
+                ),
+                block=False,
+            )
+            client.create("Service", make_lb_service())
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+
+            for round_no in (2, 3):  # expire watches twice: relist must re-arm
+                server.break_watches()
+                host = f"gone{round_no}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+                aws.add_load_balancer(f"gone{round_no}", NLB_REGION, host)
+                client.create(
+                    "Service", make_lb_service(name=f"gone{round_no}", hostname=host)
+                )
+                assert wait_until(
+                    lambda: len(aws.all_accelerator_arns()) == round_no, timeout=20.0
+                )
+        finally:
+            stop.set()
+
+
 class TestApiserverOutageRecovery:
     def test_informers_reconnect_after_apiserver_restart(self):
         """The apiserver dies and comes back on the same endpoint: the
