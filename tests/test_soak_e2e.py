@@ -1,0 +1,230 @@
+"""Soak e2e: sustained random churn, then total quiescence.
+
+The chaos tier (`test_chaos_e2e.py`) injects cloud faults; this tier
+injects *load*: a seeded stream of create/delete/annotate/port-change
+operations over a fleet of Services and Ingresses while the full
+controller stack runs with short resyncs.  Afterwards it asserts the
+three properties churn tends to break:
+
+1. **Convergence** — AWS state is exactly the image of the final
+   cluster state: one complete chain per managed object, none for
+   anything deleted or unmanaged mid-churn, records matching the
+   surviving route53 annotations.
+2. **Quiescence** — once converged, a settle window sees ZERO AWS
+   calls: resyncs redeliver old==new updates which the controllers
+   drop (the reference's resource-version guard), so steady state
+   costs nothing.
+3. **No residue** — every workqueue is empty; nothing is parked in
+   delayed-add limbo waiting to mutate AWS after the test thinks the
+   world is done.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from agac_tpu import apis
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cluster import FakeCluster
+from agac_tpu.errors import NotFoundError
+from agac_tpu.manager import ControllerConfig, Manager
+from agac_tpu.controllers import (
+    EndpointGroupBindingConfig,
+    GlobalAcceleratorConfig,
+    Route53Config,
+)
+
+from .fixtures import NLB_REGION, make_alb_ingress, make_lb_service
+from .test_chaos_e2e import alb_hostname, chain_complete, nlb_hostname
+from .test_resilience_e2e import wait_until
+
+N_SERVICE_SLOTS = 20
+N_INGRESS_SLOTS = 6
+CHURN_OPS = 400
+OWNER_TAG = "aws-global-accelerator-owner"
+
+
+class TestSoakChurn:
+    def test_churn_then_convergence_quiescence_no_residue(self):
+        rng = random.Random(20260729)
+        cluster = FakeCluster()
+        aws = FakeAWSBackend()
+        zone = aws.add_hosted_zone("example.com")
+        for i in range(N_SERVICE_SLOTS):
+            aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+        for i in range(N_INGRESS_SLOTS):
+            aws.add_load_balancer(
+                f"k8s-default-chaos{i}-0a1b2c3d4e", NLB_REGION, alb_hostname(i)
+            )
+
+        stop = threading.Event()
+        manager = Manager(resync_period=0.4)
+        manager.run(
+            cluster,
+            ControllerConfig(
+                global_accelerator=GlobalAcceleratorConfig(workers=3),
+                route53=Route53Config(workers=2),
+                endpoint_group_binding=EndpointGroupBindingConfig(),
+            ),
+            stop,
+            cloud_factory=lambda region: AWSDriver(
+                aws,
+                aws,
+                aws,
+                poll_interval=0.01,
+                poll_timeout=2.0,
+                lb_not_active_retry=0.05,
+                accelerator_missing_retry=0.05,
+            ),
+            block=False,
+        )
+
+        # desired state shadows what the cluster should hold;
+        # key -> ("svc"|"ing", index, managed, hostnames)
+        live: dict[str, tuple] = {}
+
+        def svc_name(i):
+            return f"svc{i}"
+
+        def ing_name(i):
+            return f"ing{i}"
+
+        def churn_once():
+            if rng.random() < 0.75:  # service op
+                i = rng.randrange(N_SERVICE_SLOTS)
+                name = svc_name(i)
+                if name not in live:
+                    hostnames = (
+                        [f"app{i}.example.com"] if rng.random() < 0.4 else []
+                    )
+                    ann = (
+                        {apis.ROUTE53_HOSTNAME_ANNOTATION: ",".join(hostnames)}
+                        if hostnames
+                        else {}
+                    )
+                    cluster.create(
+                        "Service",
+                        make_lb_service(
+                            name=name, hostname=nlb_hostname(i), annotations=ann
+                        ),
+                    )
+                    live[name] = ("svc", i, True, hostnames)
+                    return
+                kind, idx, managed, hostnames = live[name]
+                op = rng.random()
+                if op < 0.35:  # delete
+                    cluster.delete("Service", "default", name)
+                    del live[name]
+                elif op < 0.6:  # toggle managed (and drop route53 with it)
+                    obj = cluster.get("Service", "default", name)
+                    if managed:
+                        obj.metadata.annotations.pop(
+                            apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION, None
+                        )
+                        obj.metadata.annotations.pop(
+                            apis.ROUTE53_HOSTNAME_ANNOTATION, None
+                        )
+                        live[name] = (kind, idx, False, [])
+                    else:
+                        obj.metadata.annotations[
+                            apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+                        ] = "true"
+                        live[name] = (kind, idx, True, hostnames)
+                    cluster.update("Service", obj)
+                elif op < 0.8 and managed:  # flip route53 annotation
+                    obj = cluster.get("Service", "default", name)
+                    if hostnames:
+                        obj.metadata.annotations.pop(
+                            apis.ROUTE53_HOSTNAME_ANNOTATION, None
+                        )
+                        live[name] = (kind, idx, managed, [])
+                    else:
+                        hs = [f"app{idx}.example.com"]
+                        obj.metadata.annotations[
+                            apis.ROUTE53_HOSTNAME_ANNOTATION
+                        ] = ",".join(hs)
+                        live[name] = (kind, idx, managed, hs)
+                    cluster.update("Service", obj)
+                else:  # touch (no semantic change — still an update event)
+                    obj = cluster.get("Service", "default", name)
+                    obj.metadata.labels["touched"] = str(rng.randrange(1 << 30))
+                    cluster.update("Service", obj)
+            else:  # ingress op
+                i = rng.randrange(N_INGRESS_SLOTS)
+                name = ing_name(i)
+                if name not in live:
+                    cluster.create(
+                        "Ingress",
+                        make_alb_ingress(name=name, hostname=alb_hostname(i)),
+                    )
+                    live[name] = ("ing", i, True, [])
+                elif rng.random() < 0.5:
+                    cluster.delete("Ingress", "default", name)
+                    del live[name]
+                else:
+                    obj = cluster.get("Ingress", "default", name)
+                    obj.metadata.labels["touched"] = str(rng.randrange(1 << 30))
+                    cluster.update("Ingress", obj)
+
+        for _ in range(CHURN_OPS):
+            churn_once()
+            time.sleep(0.005)
+
+        try:
+            # 1. convergence: AWS is the exact image of final state
+            expected_owners = {
+                (f"service/default/{n}" if kind == "svc" else f"ingress/default/{n}")
+                for n, (kind, idx, managed, _) in live.items()
+                if managed
+            }
+            expected_records = set()
+            for n, (kind, idx, managed, hostnames) in live.items():
+                if managed:
+                    for h in hostnames:
+                        expected_records.add((h + ".", "A"))
+                        expected_records.add((h + ".", "TXT"))
+
+            def converged():
+                owners = set()
+                for arn in aws.all_accelerator_arns():
+                    tags = {t.key: t.value for t in aws.list_tags_for_resource(arn)}
+                    owners.add(tags.get(OWNER_TAG))
+                if owners != expected_owners:
+                    return False
+                names = {(r.name, r.type) for r in aws.records_in_zone(zone.id)}
+                return names == expected_records
+
+            assert wait_until(converged, timeout=30.0), (
+                f"expected owners {sorted(expected_owners)}, records "
+                f"{sorted(expected_records)}; got owners "
+                f"{[({t.key: t.value for t in aws.list_tags_for_resource(a)}.get(OWNER_TAG)) for a in aws.all_accelerator_arns()]}, "
+                f"records {sorted({(r.name, r.type) for r in aws.records_in_zone(zone.id)})}"
+            )
+            for n, (kind, idx, managed, _) in live.items():
+                if not managed:
+                    continue
+                owner = f"service/default/{n}" if kind == "svc" else f"ingress/default/{n}"
+                lb = nlb_hostname(idx) if kind == "svc" else alb_hostname(idx)
+                assert wait_until(lambda o=owner, l=lb: chain_complete(aws, o, l)), owner
+
+            # 2. quiescence: a settle window sees zero AWS calls even
+            # though resyncs keep firing every 0.4 s
+            def settled():
+                before = len(aws.calls)
+                time.sleep(1.2)  # three resync periods
+                return len(aws.calls) == before
+
+            assert wait_until(settled, timeout=20.0, interval=0.0), (
+                "steady state still touching AWS"
+            )
+
+            # 3. no residue: every workqueue fully drained
+            for name, controller in manager.controllers.items():
+                for attr in ("service_queue", "ingress_queue", "workqueue"):
+                    queue = getattr(controller, attr, None)
+                    if queue is not None:
+                        assert len(queue) == 0, f"{name}.{attr} not drained"
+        finally:
+            stop.set()
