@@ -36,6 +36,9 @@ from .client import ClusterClient, WatchEvent
 from .objects import Event, Ingress, Lease, Service
 from .serde import from_wire, to_wire
 
+# client-go reflectors list in pages of 500 (WatchListPageSize default)
+LIST_PAGE_SIZE = 500
+
 # kind -> (api prefix, plural, type, apiVersion string)
 KIND_REGISTRY: dict[str, tuple[str, str, type, str]] = {
     "Service": ("api/v1", "services", Service, "v1"),
@@ -190,14 +193,31 @@ class RestClusterClient(ClusterClient):
         return self._decode(kind, json.loads(body))
 
     def list(self, kind: str, namespace: Optional[str] = None) -> tuple[list[Any], str]:
-        path = self._collection_path(kind, namespace)
-        status, body = self._request("GET", path)
-        if status >= 300:
-            _raise_for_status(status, body, f"list {kind}")
-        payload = json.loads(body)
-        items = [self._decode(kind, item) for item in payload.get("items", [])]
-        rv = (payload.get("metadata") or {}).get("resourceVersion", "")
-        return items, rv
+        """Chunked list, the way client-go reflectors do it: page
+        through ``limit``/``continue`` so a large collection never
+        arrives as one giant response."""
+        base = self._collection_path(kind, namespace)
+        items: list[Any] = []
+        token = ""
+        restarted = False
+        while True:
+            query = f"?limit={LIST_PAGE_SIZE}"
+            if token:
+                query += f"&continue={urllib.parse.quote(token)}"
+            status, body = self._request("GET", base + query)
+            if status == 410 and token and not restarted:
+                # continue token expired (apiserver compaction):
+                # restart the whole list once, like client-go's pager
+                items, token, restarted = [], "", True
+                continue
+            if status >= 300:
+                _raise_for_status(status, body, f"list {kind}")
+            payload = json.loads(body)
+            items.extend(self._decode(kind, item) for item in payload.get("items", []))
+            metadata = payload.get("metadata") or {}
+            token = metadata.get("continue") or ""
+            if not token:
+                return items, metadata.get("resourceVersion", "")
 
     def create(self, kind: str, obj: Any) -> Any:
         path = self._collection_path(kind, obj.metadata.namespace or None)

@@ -206,14 +206,53 @@ class _Handler(BaseHTTPRequestHandler):
         if query.get("watch") == "true":
             self._serve_watch(route.kind, query)
             return
-        objs, rv = self.cluster.list(route.kind, route.namespace or None)
+        # chunked listing: honor limit/continue the way a real
+        # apiserver does — continue pages are served from a PINNED
+        # snapshot (never a fresh re-list, which would skip objects
+        # deleted between pages), and an expired/unknown token gets a
+        # 410 so clients restart the list
+        try:
+            limit = int(query.get("limit") or 0)
+        except ValueError:
+            self._send(400, _status_body(400, "BadRequest", "invalid limit"))
+            return
+        token = query.get("continue") or ""
+        snapshots = getattr(self.server, "list_snapshots", None)
+        if snapshots is None:
+            snapshots = self.server.list_snapshots = {}  # type: ignore[attr-defined]
+        if token:
+            try:
+                snap_id, offset_str = token.split(":", 1)
+                offset = int(offset_str)
+            except ValueError:
+                self._send(400, _status_body(400, "BadRequest", "invalid continue token"))
+                return
+            snapshot = snapshots.get(snap_id)
+            if snapshot is None:
+                self._send(
+                    410, _status_body(410, "Expired", "continue token expired")
+                )
+                return
+            objs, rv = snapshot
+        else:
+            objs, rv = self.cluster.list(route.kind, route.namespace or None)
+            offset = 0
         _, _, _, api_version = KIND_REGISTRY[route.kind]
-        items = [_full_wire(route.kind, obj) for obj in objs]
+        metadata: dict = {"resourceVersion": rv}
+        page = objs[offset:]
+        if limit and len(page) > limit:
+            page = page[:limit]
+            snap_id = token.split(":", 1)[0] if token else f"s{id(objs)}-{rv}"
+            snapshots[snap_id] = (objs, rv)
+            metadata["continue"] = f"{snap_id}:{offset + limit}"
+        elif token:
+            snapshots.pop(token.split(":", 1)[0], None)  # fully consumed
+        items = [_full_wire(route.kind, obj) for obj in page]
         body = json.dumps(
             {
                 "apiVersion": api_version,
                 "kind": f"{route.kind}List",
-                "metadata": {"resourceVersion": rv},
+                "metadata": metadata,
                 "items": items,
             }
         ).encode()

@@ -71,8 +71,62 @@ def test_list_returns_items_and_rv(client, stub):
         },
     )
     items, rv = client.list("Service")
-    assert stub.requests[0][1].endswith("/api/v1/services")
+    assert stub.requests[0][1].endswith("/api/v1/services?limit=500")
     assert rv == "42" and [i.metadata.name for i in items] == ["a", "b"]
+
+
+def test_list_follows_continue_tokens(client, stub, monkeypatch):
+    """Chunked listing: pages are concatenated until the apiserver
+    stops returning a continue token (client-go reflector behavior)."""
+    from agac_tpu.cluster import rest as rest_mod
+
+    monkeypatch.setattr(rest_mod, "LIST_PAGE_SIZE", 2)
+    stub.queue(
+        200,
+        {
+            "metadata": {"resourceVersion": "41", "continue": "2"},
+            "items": [{"metadata": {"name": "a"}}, {"metadata": {"name": "b"}}],
+        },
+    )
+    stub.queue(
+        200,
+        {
+            "metadata": {"resourceVersion": "42"},
+            "items": [{"metadata": {"name": "c"}}],
+        },
+    )
+    items, rv = client.list("Service")
+    assert [i.metadata.name for i in items] == ["a", "b", "c"]
+    assert rv == "42"
+    assert stub.requests[0][1].endswith("/api/v1/services?limit=2")
+    assert stub.requests[1][1].endswith("/api/v1/services?limit=2&continue=2")
+
+
+def test_list_restarts_once_on_expired_continue(client, stub, monkeypatch):
+    """410 on a continue page (apiserver compacted the snapshot) makes
+    the client restart the list from the beginning, like client-go's
+    pager fallback."""
+    from agac_tpu.cluster import rest as rest_mod
+
+    monkeypatch.setattr(rest_mod, "LIST_PAGE_SIZE", 2)
+    stub.queue(
+        200,
+        {
+            "metadata": {"resourceVersion": "10", "continue": "t1"},
+            "items": [{"metadata": {"name": "a"}}, {"metadata": {"name": "b"}}],
+        },
+    )
+    stub.queue(410, {"kind": "Status", "code": 410, "reason": "Expired"})
+    stub.queue(
+        200,
+        {
+            "metadata": {"resourceVersion": "11"},
+            "items": [{"metadata": {"name": "a"}}, {"metadata": {"name": "c"}}],
+        },
+    )
+    items, rv = client.list("Service")
+    assert [i.metadata.name for i in items] == ["a", "c"] and rv == "11"
+    assert len(stub.requests) == 3
 
 
 def test_create_posts_wire_body_with_type_meta(client, stub):
