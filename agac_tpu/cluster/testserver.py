@@ -244,6 +244,10 @@ class _Handler(BaseHTTPRequestHandler):
             page = page[:limit]
             snap_id = token.split(":", 1)[0] if token else f"s{id(objs)}-{rv}"
             snapshots[snap_id] = (objs, rv)
+            # abandoned paginations must not accumulate: evict oldest
+            # (clients holding an evicted token get the 410 above)
+            while len(snapshots) > 32:
+                snapshots.pop(next(iter(snapshots)))
             metadata["continue"] = f"{snap_id}:{offset + limit}"
         elif token:
             snapshots.pop(token.split(":", 1)[0], None)  # fully consumed

@@ -353,6 +353,86 @@ class TestChaosFleet:
         finally:
             stop.set()
 
+    def test_two_clusters_share_one_aws_account_without_stealing(self):
+        """Two controllers with different --cluster-name values manage
+        the same AWS account (the reference's ownership model: cluster
+        tag + cluster-scoped Route53 TXT heritage value). Each must
+        only ever touch its own resources — including during cleanup,
+        which scans EVERY hosted zone and EVERY accelerator by tags."""
+        aws = FakeAWSBackend()  # the shared AWS account
+        zone = aws.add_hosted_zone("example.com")
+        worlds = {}
+        for cluster_name, i in (("blue", 0), ("green", 1)):
+            aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+            cluster = FakeCluster()
+            config = ControllerConfig(
+                global_accelerator=GlobalAcceleratorConfig(
+                    cluster_name=cluster_name, queue_max_backoff=0.25
+                ),
+                route53=Route53Config(
+                    cluster_name=cluster_name, queue_max_backoff=0.25
+                ),
+                endpoint_group_binding=EndpointGroupBindingConfig(),
+            )
+            stop = start_manager(cluster, aws, config=config)
+            worlds[cluster_name] = (cluster, stop, i)
+
+        try:
+            for cluster_name, (cluster, _, i) in worlds.items():
+                cluster.create(
+                    "Service",
+                    make_lb_service(
+                        name="web",  # same ns/name in both clusters!
+                        hostname=nlb_hostname(i),
+                        annotations={
+                            apis.ROUTE53_HOSTNAME_ANNOTATION: f"{cluster_name}.example.com"
+                        },
+                    ),
+                )
+
+            def both_converged():
+                if len(aws.all_accelerator_arns()) != 2:
+                    return False
+                names = {(r.name, r.type) for r in aws.records_in_zone(zone.id)}
+                return names >= {
+                    ("blue.example.com.", "A"),
+                    ("green.example.com.", "A"),
+                }
+
+            assert wait_until(both_converged, timeout=20.0)
+            clusters_by_arn = {
+                arn: {t.key: t.value for t in aws.list_tags_for_resource(arn)}[
+                    "aws-global-accelerator-cluster"
+                ]
+                for arn in aws.all_accelerator_arns()
+            }
+            assert sorted(clusters_by_arn.values()) == ["blue", "green"]
+
+            # blue tears down; green's identically-named resources must
+            # survive blue's zone-wide/account-wide ownership scans
+            blue_cluster, _, _ = worlds["blue"]
+            blue_cluster.delete("Service", "default", "web")
+
+            def blue_gone_green_intact():
+                remaining = {
+                    {t.key: t.value for t in aws.list_tags_for_resource(arn)}[
+                        "aws-global-accelerator-cluster"
+                    ]
+                    for arn in aws.all_accelerator_arns()
+                }
+                if remaining != {"green"}:
+                    return False
+                names = {(r.name, r.type) for r in aws.records_in_zone(zone.id)}
+                return ("blue.example.com.", "A") not in names and names >= {
+                    ("green.example.com.", "A"),
+                    ("green.example.com.", "TXT"),
+                }
+
+            assert wait_until(blue_gone_green_intact, timeout=20.0)
+        finally:
+            for _, stop, _ in worlds.values():
+                stop.set()
+
     def test_concurrent_workers_create_no_duplicates(self):
         """12 services, 4 workers, no faults: exactly one
         CreateAccelerator per service — the workqueue's same-key
