@@ -243,9 +243,11 @@ class _Handler(BaseHTTPRequestHandler):
         if limit and len(page) > limit:
             page = page[:limit]
             snap_id = token.split(":", 1)[0] if token else f"s{id(objs)}-{rv}"
-            snapshots[snap_id] = (objs, rv)
-            # abandoned paginations must not accumulate: evict oldest
+            # LRU: move-to-end on every touch so an ACTIVE pagination
+            # outlives younger abandoned ones, then evict oldest
             # (clients holding an evicted token get the 410 above)
+            snapshots.pop(snap_id, None)
+            snapshots[snap_id] = (objs, rv)
             while len(snapshots) > 32:
                 snapshots.pop(next(iter(snapshots)))
             metadata["continue"] = f"{snap_id}:{offset + limit}"

@@ -11,7 +11,9 @@ then dispatching to the validator.
 from __future__ import annotations
 
 import json
+import os
 import ssl
+import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import klog
@@ -80,14 +82,65 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
+def _reloading_tls_context(cert_file: str, key_file: str) -> ssl.SSLContext:
+    """TLS context that re-reads the cert/key when the files change.
+
+    cert-manager rotates webhook certificates in place; the reference's
+    Go server loads the pair once at startup and serves the stale cert
+    until the pod restarts.  Here every handshake's SNI callback checks
+    the files' mtimes and swaps in a freshly loaded context when they
+    moved — a half-written rotation (cert/key momentarily mismatched)
+    keeps serving the previous pair instead of breaking handshakes.
+    The kube-apiserver always sends SNI (it dials the service DNS
+    name); a client that omits SNI keeps the startup certificate.
+    """
+    lock = threading.Lock()
+    state: dict = {"mtime": None, "context": None}
+
+    def mtimes():
+        return (os.stat(cert_file).st_mtime_ns, os.stat(key_file).st_mtime_ns)
+
+    def load() -> ssl.SSLContext:
+        context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        context.load_cert_chain(cert_file, key_file)
+        context.sni_callback = sni
+        return context
+
+    def current() -> ssl.SSLContext:
+        try:
+            mtime = mtimes()
+        except OSError:
+            return state["context"]  # mid-rotation: files briefly absent
+        with lock:
+            if mtime != state["mtime"]:
+                try:
+                    state["context"] = load()
+                    state["mtime"] = mtime
+                    klog.infof("Loaded TLS certificate from %s", cert_file)
+                except (ssl.SSLError, OSError) as err:
+                    klog.errorf("Failed to reload TLS certificate: %s", err)
+            return state["context"]
+
+    def sni(sslobj, server_name, base_context):
+        fresh = current()
+        if fresh is not None and fresh is not sslobj.context:
+            sslobj.context = fresh
+        return None
+
+    # first load is outside current(): a bad pair at startup must
+    # fail fast with the real SSLError, not an opaque None downstream
+    state["mtime"] = mtimes()
+    state["context"] = load()
+    return state["context"]
+
+
 def make_server(port: int, tls_cert_file: str = "", tls_key_file: str = "", host: str = "") -> ThreadingHTTPServer:
     """Build the server (separately from serving, so tests can bind
     port 0 and shut down cleanly)."""
     server = ThreadingHTTPServer((host, port), _Handler)
     ssl_on = bool(tls_cert_file and tls_key_file)
     if ssl_on:
-        context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        context.load_cert_chain(tls_cert_file, tls_key_file)
+        context = _reloading_tls_context(tls_cert_file, tls_key_file)
         server.socket = context.wrap_socket(server.socket, server_side=True)
     klog.infof("Listening on :%d, SSL is %s", port, str(ssl_on).lower())
     return server

@@ -330,6 +330,57 @@ class TestWatchExpiry:
             stop.set()
 
 
+class TestScaleThroughHTTP:
+    def test_600_preexisting_services_converge(self, server):
+        """600 annotated Services exist BEFORE the controller starts:
+        the informer's initial list spans multiple continue pages
+        (>LIST_PAGE_SIZE objects) and every object must still reach a
+        complete accelerator chain — pagination, cache priming, and
+        queue throughput exercised together over live HTTP."""
+        n = 600
+        client = RestClusterClient(server.url)
+        aws = FakeAWSBackend()
+        for i in range(n):
+            host = f"big{i:04d}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+            aws.add_load_balancer(f"big{i:04d}", NLB_REGION, host)
+            server.cluster.create(  # seed storage directly: faster than HTTP
+                "Service", make_lb_service(name=f"big{i:04d}", hostname=host)
+            )
+
+        from agac_tpu.cloudprovider.aws.cache import DiscoveryCache
+        from agac_tpu.controllers import (
+            EndpointGroupBindingConfig,
+            GlobalAcceleratorConfig,
+            Route53Config,
+        )
+
+        cache = DiscoveryCache(ttl=5.0)
+        stop = threading.Event()
+        try:
+            Manager(resync_period=300).run(
+                client,
+                ControllerConfig(
+                    global_accelerator=GlobalAcceleratorConfig(
+                        workers=8, queue_qps=0.0
+                    ),
+                    route53=Route53Config(workers=2, queue_qps=0.0),
+                    endpoint_group_binding=EndpointGroupBindingConfig(),
+                ),
+                stop,
+                cloud_factory=lambda region: AWSDriver(
+                    aws, aws, aws,
+                    poll_interval=0.01, poll_timeout=2.0,
+                    discovery_cache=cache,
+                ),
+                block=False,
+            )
+            assert wait_until(
+                lambda: len(aws.all_accelerator_arns()) == n, timeout=60.0
+            ), f"only {len(aws.all_accelerator_arns())}/{n} chains converged"
+        finally:
+            stop.set()
+
+
 class TestApiserverOutageRecovery:
     def test_informers_reconnect_after_apiserver_restart(self):
         """The apiserver dies and comes back on the same endpoint: the

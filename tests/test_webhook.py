@@ -150,3 +150,99 @@ class TestServer:
     def test_unknown_path_404(self, server):
         status, _ = post(f"{server}/other", {"x": 1})
         assert status == 404
+
+
+class TestTLS:
+    """Live HTTPS: the webhook serves with TLS and hot-reloads a
+    rotated certificate without a restart (cert-manager renews certs
+    in place; the reference serves the stale cert until pod restart)."""
+
+    @staticmethod
+    def gen_cert(directory, cn):
+        import subprocess
+
+        cert = directory / f"{cn}.crt"
+        key = directory / f"{cn}.key"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", str(key), "-out", str(cert),
+                "-days", "1", "-nodes", "-subj", f"/CN={cn}",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        return cert.read_bytes(), key.read_bytes()
+
+    @pytest.fixture
+    def tls_server(self, tmp_path):
+        cert1, key1 = self.gen_cert(tmp_path, "one.example")
+        cert_file, key_file = tmp_path / "tls.crt", tmp_path / "tls.key"
+        cert_file.write_bytes(cert1)
+        key_file.write_bytes(key1)
+        srv = make_server(0, str(cert_file), str(key_file))
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        yield srv.server_address[1], cert_file, key_file, tmp_path
+        srv.shutdown()
+        srv.server_close()
+
+    @staticmethod
+    def served_cn(port):
+        import socket
+        import ssl as ssl_mod
+
+        context = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+        context.check_hostname = False
+        context.verify_mode = ssl_mod.CERT_NONE
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as sock:
+            # server_hostname supplies SNI, like the kube-apiserver does
+            with context.wrap_socket(sock, server_hostname="webhook.svc") as tls:
+                der = tls.getpeercert(binary_form=True)
+        import subprocess
+
+        out = subprocess.run(
+            ["openssl", "x509", "-inform", "der", "-noout", "-subject"],
+            input=der,
+            check=True,
+            capture_output=True,
+        ).stdout.decode()
+        return out.strip().rsplit("CN", 1)[-1].lstrip("= ")
+
+    def test_serves_https_and_healthz(self, tls_server):
+        import ssl as ssl_mod
+
+        port, *_ = tls_server
+        context = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+        context.check_hostname = False
+        context.verify_mode = ssl_mod.CERT_NONE
+        with urllib.request.urlopen(
+            f"https://127.0.0.1:{port}/healthz", timeout=5, context=context
+        ) as response:
+            assert response.status == 200
+
+    def test_bad_pair_at_startup_fails_fast(self, tmp_path):
+        import ssl as ssl_mod
+
+        cert1, _ = self.gen_cert(tmp_path, "one.example")
+        _, key2 = self.gen_cert(tmp_path, "two.example")
+        cert_file, key_file = tmp_path / "tls.crt", tmp_path / "tls.key"
+        cert_file.write_bytes(cert1)
+        key_file.write_bytes(key2)  # mismatched pair
+        with pytest.raises(ssl_mod.SSLError):
+            make_server(0, str(cert_file), str(key_file))
+
+    def test_rotated_cert_served_without_restart(self, tls_server):
+        port, cert_file, key_file, tmp_path = tls_server
+        assert self.served_cn(port) == "one.example"
+
+        cert2, key2 = self.gen_cert(tmp_path, "two.example")
+        cert_file.write_bytes(cert2)
+        key_file.write_bytes(key2)
+        assert self.served_cn(port) == "two.example"
+
+        # half-written rotation: key doesn't match cert — keep serving
+        # the previous pair rather than failing handshakes
+        cert3, _ = self.gen_cert(tmp_path, "three.example")
+        cert_file.write_bytes(cert3)
+        assert self.served_cn(port) == "two.example"
