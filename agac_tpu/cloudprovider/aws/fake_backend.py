@@ -50,13 +50,11 @@ from .types import (
     CHANGE_ACTION_UPSERT,
     Accelerator,
     Change,
-    EndpointConfiguration,
     EndpointDescription,
     EndpointGroup,
     HostedZone,
     Listener,
     LoadBalancer,
-    PortRange,
     ResourceRecordSet,
     Tag,
 )

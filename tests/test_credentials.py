@@ -1,7 +1,6 @@
 """Credential resolution tests: env, shared file, IRSA web identity
 (stubbed STS), and provider-driven refresh of expiring sessions."""
 
-import contextlib
 import io
 import urllib.parse
 

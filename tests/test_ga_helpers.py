@@ -3,7 +3,6 @@
 protocol/port drift, listener derivation incl. the ALB listen-ports
 annotation) plus tag/name helpers."""
 
-import pytest
 
 from agac_tpu import apis
 from agac_tpu.cluster import (

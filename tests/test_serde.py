@@ -6,12 +6,9 @@ from typing import Optional
 
 from agac_tpu.cluster import (
     Ingress,
-    IngressBackend,
-    IngressServiceBackend,
     LoadBalancerIngress,
     ObjectMeta,
     Service,
-    ServiceBackendPort,
     ServicePort,
 )
 from agac_tpu.cluster.objects import IngressSpec, ServiceSpec, ServiceStatus, LoadBalancerStatus

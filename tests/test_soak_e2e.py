@@ -28,7 +28,6 @@ import time
 from agac_tpu import apis
 from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
 from agac_tpu.cluster import FakeCluster
-from agac_tpu.errors import NotFoundError
 from agac_tpu.manager import ControllerConfig, Manager
 from agac_tpu.controllers import (
     EndpointGroupBindingConfig,
