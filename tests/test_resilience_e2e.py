@@ -181,6 +181,27 @@ class TestRestartResume:
             stop.set()
 
 
+class TestCleanShutdown:
+    def test_no_thread_leak_across_generations(self, world):
+        """Every manager generation's threads (workers, informer
+        watch/dispatch, queue delay-wakers) exit when stop fires —
+        leader-election failover restarts the manager in-process, so
+        leaked threads would accumulate until OOM."""
+        import threading as threading_mod
+
+        baseline = threading_mod.active_count()
+        for _ in range(3):
+            cluster, aws = FakeCluster(), FakeAWSBackend()
+            aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            stop = start_manager(cluster, aws)
+            cluster.create("Service", make_lb_service())
+            assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
+            stop.set()
+            assert wait_until(
+                lambda: threading_mod.active_count() <= baseline, timeout=5.0
+            ), [t.name for t in threading_mod.enumerate()]
+
+
 class ThrottlingAWS(FakeAWSBackend):
     """Fails the first N calls of one operation with a retryable API
     error — the ThrottlingException shape."""
