@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import random
 import time
+import threading
 import uuid
 import urllib.error
 import urllib.parse
@@ -689,6 +690,25 @@ class RealRoute53API(Route53API):
             raise _xml_error(status, response)
 
 
+_process_provider: Optional[CredentialProvider] = None
+_provider_lock = threading.Lock()
+
+
+def _shared_credential_provider() -> CredentialProvider:
+    """ONE provider for the whole process.  `from_environment` runs
+    per reconcile (the reference's `NewAWS(region)`-per-item shape);
+    a fresh provider each time would redo credential resolution —
+    under IRSA that is an STS AssumeRoleWithWebIdentity round trip per
+    work item, pure latency plus an STS throttling risk at fleet
+    scale.  The provider caches until expiry and refreshes itself, so
+    sharing is exactly what it is built for."""
+    global _process_provider
+    with _provider_lock:
+        if _process_provider is None:
+            _process_provider = CredentialProvider()
+        return _process_provider
+
+
 @dataclass
 class RealAWSClients:
     ga: RealGlobalAcceleratorAPI
@@ -697,9 +717,7 @@ class RealAWSClients:
 
     @classmethod
     def from_environment(cls, region: str) -> "RealAWSClients":
-        # one shared provider: resolution happens lazily on first call
-        # and refreshes automatically for session credentials
-        provider = CredentialProvider()
+        provider = _shared_credential_provider()
         return cls(
             ga=RealGlobalAcceleratorAPI(provider),
             elbv2=RealELBv2API(region, provider),

@@ -442,3 +442,18 @@ class TestRoute53Protocol:
         with pytest.raises(AWSAPIError) as exc:
             client.list_hosted_zones(100, None)
         assert exc.value.code == "NoSuchHostedZone"
+
+
+def test_from_environment_shares_one_credential_provider(monkeypatch):
+    """`from_environment` runs per reconcile; every bundle must reuse
+    the process-wide provider so IRSA resolution (an STS round trip)
+    happens once per expiry window, not once per work item."""
+    from agac_tpu.cloudprovider.aws import real_backend
+
+    monkeypatch.setattr(real_backend, "_process_provider", None)
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKID")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "SECRET")
+    a = real_backend.RealAWSClients.from_environment("us-west-2")
+    b = real_backend.RealAWSClients.from_environment("eu-west-1")
+    assert a.ga._client._provider is b.route53._client._provider
+    assert a.elbv2._client._provider is b.elbv2._client._provider
