@@ -125,7 +125,18 @@ def resolve_credentials(urlopen=urllib.request.urlopen) -> Credentials:
 
 class CredentialProvider:
     """Caches credentials and re-resolves them before expiry; safe to
-    share across service clients and threads."""
+    share across service clients and threads.
+
+    Resolved credentials WITHOUT an expiration (env vars, shared
+    credentials file) are still re-resolved every
+    ``STATIC_REFRESH_SECONDS`` so in-place key rotation is picked up —
+    the provider is shared process-wide, and without a TTL a rotated
+    credentials file would be ignored until restart (the reference
+    re-resolves per reconcile via its ``NewAWS`` calls).  Explicit
+    static ``Credentials`` passed to the constructor never re-resolve.
+    """
+
+    STATIC_REFRESH_SECONDS = 300.0
 
     def __init__(
         self,
@@ -141,15 +152,22 @@ class CredentialProvider:
         self._cached: Optional[Credentials] = static
         self._lock = threading.Lock()
         self._resolve_cooldown_until = 0.0
+        self._resolved_at = 0.0
 
     def get(self) -> Credentials:
         with self._lock:
             cached = self._cached
-            if cached is not None and (
-                cached.expiration is None
-                or cached.expiration - self._clock() > _EXPIRY_MARGIN
-            ):
-                return cached
+            if cached is self._static and cached is not None:
+                if cached.expiration is None:
+                    return cached
+            elif cached is not None:
+                fresh_enough = (
+                    self._clock() - self._resolved_at < self.STATIC_REFRESH_SECONDS
+                    if cached.expiration is None
+                    else cached.expiration - self._clock() > _EXPIRY_MARGIN
+                )
+                if fresh_enough:
+                    return cached
             if self._static is not None and self._static.expiration is None:
                 return self._static
             def cached_still_valid() -> bool:
@@ -164,6 +182,7 @@ class CredentialProvider:
                 return cached
             try:
                 self._cached = self._resolver()
+                self._resolved_at = self._clock()
                 self._resolve_cooldown_until = 0.0
             except Exception:
                 # transient resolver failure (e.g. STS unreachable):

@@ -216,6 +216,8 @@ class Event:
     type: str = "Normal"
     count: int = 1
     source: EventSource = field(default_factory=EventSource)
+    first_timestamp: Optional[str] = None
+    last_timestamp: Optional[str] = None
 
 
 # ---------------------------------------------------------------------------

@@ -115,6 +115,7 @@ class EndpointGroupBindingController:
         stop.wait()
         klog.info("Shutting down workers")
         self.workqueue.shutdown()
+        self.recorder.shutdown()
 
     def _key_to_binding(self, key: str):
         ns, name = split_meta_namespace_key(key)

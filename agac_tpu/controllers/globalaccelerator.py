@@ -207,6 +207,7 @@ class GlobalAcceleratorController:
         klog.info("Shutting down workers")
         self.service_queue.shutdown()
         self.ingress_queue.shutdown()
+        self.recorder.shutdown()
 
     def _key_to_service(self, key: str):
         ns, name = split_meta_namespace_key(key)

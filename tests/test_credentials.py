@@ -144,3 +144,30 @@ def test_provider_serves_cached_when_refresh_fails_within_margin():
 
     with _pytest.raises(RuntimeError, match="STS unreachable"):
         provider.get()
+
+
+def test_non_expiring_resolved_creds_refresh_on_ttl():
+    """Env/file credentials have no expiration, but the provider is
+    shared process-wide — without a TTL an in-place key rotation would
+    be ignored until restart (the reference re-resolves per reconcile)."""
+    from agac_tpu.cloudprovider.aws.sigv4 import CredentialProvider, Credentials
+
+    clock = [1000.0]
+    generation = [0]
+
+    def resolver():
+        generation[0] += 1
+        return Credentials(f"AKID{generation[0]}", "secret")
+
+    provider = CredentialProvider(resolver=resolver, clock=lambda: clock[0])
+    assert provider.get().access_key_id == "AKID1"
+    clock[0] += 100
+    assert provider.get().access_key_id == "AKID1"  # inside TTL: cached
+    clock[0] += 300
+    assert provider.get().access_key_id == "AKID2"  # TTL expired: rotated keys
+
+    # explicit static credentials never re-resolve
+    static = Credentials("STATIC", "secret")
+    provider2 = CredentialProvider(static=static, clock=lambda: clock[0])
+    clock[0] += 10_000
+    assert provider2.get() is static

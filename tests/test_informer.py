@@ -147,6 +147,7 @@ def test_event_recorder_persists_events(cluster):
     recorder = EventRecorder(cluster, "test-controller")
     svc = cluster.create("Service", make_svc())
     recorder.eventf(svc, "Normal", "GlobalAcceleratorCreated", "Global Accelerator is created: %s", "arn:x")
+    assert recorder.flush()
     events, _ = cluster.list("Event")
     assert len(events) == 1
     ev = events[0]
@@ -155,3 +156,43 @@ def test_event_recorder_persists_events(cluster):
     assert ev.involved_object.kind == "Service"
     assert ev.involved_object.name == "web"
     assert ev.source.component == "test-controller"
+
+
+def test_event_recorder_aggregates_repeats(cluster):
+    """A repeat of the same (object, type, reason, message) within the
+    aggregation window bumps count on the existing Event instead of
+    creating a new object (client-go EventCorrelator behavior)."""
+    recorder = EventRecorder(cluster, "test-controller")
+    svc = cluster.create("Service", make_svc())
+    for _ in range(5):
+        recorder.event(svc, "Normal", "Repaired", "chain repaired")
+    assert recorder.flush()
+    events, _ = cluster.list("Event")
+    assert len(events) == 1
+    assert events[0].count == 5
+    assert events[0].first_timestamp and events[0].last_timestamp
+
+    # a different message is a different series
+    recorder.event(svc, "Normal", "Repaired", "something else")
+    assert recorder.flush()
+    events, _ = cluster.list("Event")
+    assert len(events) == 2
+
+
+def test_event_recorder_spam_filter(cluster):
+    """Distinct events on one object beyond the 25-token burst are
+    dropped before reaching the apiserver."""
+    recorder = EventRecorder(cluster, "test-controller", clock=lambda: 1000.0)
+    svc = cluster.create("Service", make_svc())
+    for i in range(40):
+        recorder.event(svc, "Normal", "Flood", f"message {i}")
+    assert recorder.flush()
+    events, _ = cluster.list("Event")
+    assert len(events) == 25
+
+    # tokens refill with time: one more event lands 5 minutes later
+    recorder._clock = lambda: 1000.0 + 301.0
+    recorder.event(svc, "Normal", "Flood", "late message")
+    assert recorder.flush()
+    events, _ = cluster.list("Event")
+    assert len(events) == 26
