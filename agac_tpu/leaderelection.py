@@ -12,6 +12,19 @@ the renew deadline — the reference exits the process there
 The elector speaks to the apiserver only through ``ClusterClient``
 (Lease get/create/update with optimistic concurrency), so it runs
 against both the fake and the REST client.
+
+Clock-skew independence (client-go semantics): the holder's
+``renewTime`` written by the *other* replica is never compared against
+this process's wall clock.  Instead the elector records, on the local
+monotonic clock, when it last *observed the lease record change*
+(holder/renewTime/acquireTime/transitions tuple).  The lease is
+considered live until ``observed_time + lease_duration`` on the local
+clock — so a holder whose wall clock is minutes ahead or behind still
+keeps its lease as long as it keeps writing, and a crashed holder is
+superseded one full lease_duration after its last observed write.
+This mirrors the ``observedRecord``/``observedTime`` pair in client-go's
+``leaderelection.go`` (as wrapped by the reference's
+``pkg/leaderelection/leaderelection.go:47-73``).
 """
 
 from __future__ import annotations
@@ -36,21 +49,6 @@ def _now_rfc3339() -> str:
     )
 
 
-def _parse_rfc3339(value: str) -> float:
-    import datetime
-
-    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
-        try:
-            return (
-                datetime.datetime.strptime(value, fmt)
-                .replace(tzinfo=datetime.timezone.utc)
-                .timestamp()
-            )
-        except ValueError:
-            continue
-    return 0.0
-
-
 @dataclass
 class LeaderElectionConfig:
     lease_duration: float = 60.0
@@ -71,6 +69,11 @@ class LeaderElection:
         self.config = config or LeaderElectionConfig()
         self.identity = identity or str(uuid.uuid4())
         self._leading = threading.Event()
+        # Observed-record tracking (client-go's observedRecord /
+        # observedTime): the lease's last-seen content and the local
+        # monotonic time at which it was first seen in that state.
+        self._observed_record: Optional[tuple] = None
+        self._observed_time: float = 0.0
 
     def is_leader(self) -> bool:
         return self._leading.is_set()
@@ -167,12 +170,29 @@ class LeaderElection:
             klog.errorf("error retrieving lease %s/%s: %s", self.namespace, self.name, err)
             return False, ""
 
+        record = (
+            lease.spec.holder_identity,
+            lease.spec.renew_time,
+            lease.spec.acquire_time,
+            lease.spec.lease_transitions,
+        )
+        if record != self._observed_record:
+            self._observed_record = record
+            self._observed_time = time.monotonic()
+
         holder = lease.spec.holder_identity or ""
         if holder != self.identity:
-            renew_time = _parse_rfc3339(lease.spec.renew_time or "")
-            duration = lease.spec.lease_duration_seconds or self.config.lease_duration
-            if renew_time + duration > time.time():
-                return False, holder  # lease is held and fresh
+            if holder:
+                # Freshness on the LOCAL monotonic clock only: the lease
+                # is live until one lease_duration after we last saw its
+                # record change.  The holder's own renewTime timestamp is
+                # deliberately ignored here — comparing a remote wall
+                # clock to ours can elect two leaders under skew.
+                duration = (
+                    lease.spec.lease_duration_seconds or self.config.lease_duration
+                )
+                if self._observed_time + duration > time.monotonic():
+                    return False, holder  # lease is held and fresh
             lease.spec.lease_transitions += 1
             lease.spec.acquire_time = now
         lease.spec.holder_identity = self.identity

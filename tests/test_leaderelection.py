@@ -15,6 +15,31 @@ def fast_config(lease=0.5, renew=0.3, retry=0.05):
     )
 
 
+def stamp(offset_seconds=0.0):
+    import datetime
+
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        + datetime.timedelta(seconds=offset_seconds)
+    ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def plant_lease(cluster, holder, renew_offset_seconds, duration=1):
+    from agac_tpu.cluster.objects import Lease, LeaseSpec, ObjectMeta
+
+    cluster.create(
+        "Lease",
+        Lease(
+            metadata=ObjectMeta(name="test-lock", namespace="default"),
+            spec=LeaseSpec(
+                holder_identity=holder,
+                lease_duration_seconds=duration,
+                renew_time=stamp(renew_offset_seconds),
+            ),
+        ),
+    )
+
+
 def start_candidate(cluster, identity, stop, events, config=None):
     election = LeaderElection(
         "test-lock", "default", config or fast_config(), identity=identity
@@ -79,24 +104,7 @@ def test_takeover_after_lease_expiry_without_release():
     cluster = FakeCluster()
     # leader that never releases: simulate by directly planting a lease
     # held by a vanished process
-    from agac_tpu.cluster.objects import Lease, LeaseSpec, ObjectMeta
-    import datetime
-
-    stale_time = (
-        datetime.datetime.now(datetime.timezone.utc)
-        - datetime.timedelta(seconds=10)
-    ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
-    cluster.create(
-        "Lease",
-        Lease(
-            metadata=ObjectMeta(name="test-lock", namespace="default"),
-            spec=LeaseSpec(
-                holder_identity="dead-process",
-                lease_duration_seconds=1,
-                renew_time=stale_time,
-            ),
-        ),
-    )
+    plant_lease(cluster, "dead-process", renew_offset_seconds=-10)
     events = []
     stop = threading.Event()
     elector, _ = start_candidate(cluster, "successor", stop, events)
@@ -107,6 +115,62 @@ def test_takeover_after_lease_expiry_without_release():
     lease = cluster.get("Lease", "default", "test-lock")
     assert lease.spec.holder_identity == "successor"
     assert lease.spec.lease_transitions == 1
+    stop.set()
+
+
+def test_no_steal_while_skewed_holder_keeps_renewing():
+    """A holder whose wall clock is 10 min behind (it writes renewTime
+    timestamps far in the past) must keep its lease as long as it keeps
+    writing: freshness is judged on the follower's LOCAL monotonic
+    clock from the last observed record change, never by comparing the
+    remote timestamp to local time (client-go observedRecord
+    semantics)."""
+    cluster = FakeCluster()
+    plant_lease(cluster, "skewed-holder", renew_offset_seconds=-600)
+    renewing = threading.Event()
+
+    def holder_renew_loop():
+        while not renewing.is_set():
+            lease = cluster.get("Lease", "default", "test-lock")
+            if lease.spec.holder_identity != "skewed-holder":
+                return
+            lease.spec.renew_time = stamp(-600)
+            try:
+                cluster.update("Lease", lease)
+            except Exception:
+                pass
+            time.sleep(0.05)
+
+    holder = threading.Thread(target=holder_renew_loop, daemon=True)
+    holder.start()
+
+    events = []
+    stop = threading.Event()
+    elector, _ = start_candidate(cluster, "challenger", stop, events)
+    time.sleep(1.5)  # > lease_duration_seconds: old code would steal here
+    assert not elector.is_leader()
+    lease = cluster.get("Lease", "default", "test-lock")
+    assert lease.spec.holder_identity == "skewed-holder"
+    renewing.set()
+    stop.set()
+
+
+def test_steal_after_local_duration_despite_future_renew_time():
+    """A crashed holder that last wrote renewTime 10 min in the FUTURE
+    (its clock was ahead) must still be superseded one lease_duration
+    after the follower first observes the (now unchanging) record —
+    remote timestamps must not postpone failover."""
+    cluster = FakeCluster()
+    plant_lease(cluster, "dead-future-clock", renew_offset_seconds=600)
+    events = []
+    stop = threading.Event()
+    elector, _ = start_candidate(cluster, "successor", stop, events)
+    deadline = time.monotonic() + 4
+    while not elector.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert elector.is_leader()
+    lease = cluster.get("Lease", "default", "test-lock")
+    assert lease.spec.holder_identity == "successor"
     stop.set()
 
 
