@@ -306,13 +306,20 @@ class AWSDriver:
     # ------------------------------------------------------------------
     # Global Accelerator: discovery
     # ------------------------------------------------------------------
-    def _list_accelerators(self) -> list[Accelerator]:
+    @staticmethod
+    def _drain_pages(fetch):
+        """Exhaust a paginated list API: ``fetch(token)`` returns
+        ``(page, next_token)``; pages are concatenated until the token
+        comes back None (every AWS list here paginates this way)."""
         items, token = [], None
         while True:
-            page, token = self.ga.list_accelerators(100, token)
+            page, token = fetch(token)
             items.extend(page)
             if token is None:
                 return items
+
+    def _list_accelerators(self) -> list[Accelerator]:
+        return self._drain_pages(lambda token: self.ga.list_accelerators(100, token))
 
     def _load_discovery_snapshot(self) -> list[tuple[Accelerator, list[Tag]]]:
         return [
@@ -632,12 +639,9 @@ class AWSDriver:
     def get_listener(self, accelerator_arn: str) -> Listener:
         """Exactly one listener per managed accelerator
         (reference ``global_accelerator.go:770-794``)."""
-        listeners, token = [], None
-        while True:
-            page, token = self.ga.list_listeners(accelerator_arn, 100, token)
-            listeners.extend(page)
-            if token is None:
-                break
+        listeners = self._drain_pages(
+            lambda token: self.ga.list_listeners(accelerator_arn, 100, token)
+        )
         if not listeners:
             raise ListenerNotFoundException(accelerator_arn)
         if len(listeners) > 1:
@@ -648,12 +652,9 @@ class AWSDriver:
     def get_endpoint_group(self, listener_arn: str) -> EndpointGroup:
         """Exactly one endpoint group per managed listener
         (reference ``global_accelerator.go:866-888``)."""
-        groups, token = [], None
-        while True:
-            page, token = self.ga.list_endpoint_groups(listener_arn, 100, token)
-            groups.extend(page)
-            if token is None:
-                break
+        groups = self._drain_pages(
+            lambda token: self.ga.list_endpoint_groups(listener_arn, 100, token)
+        )
         if not groups:
             raise EndpointGroupNotFoundException(listener_arn)
         if len(groups) > 1:
@@ -668,11 +669,11 @@ class AWSDriver:
     # Global Accelerator: cleanup (reference ``global_accelerator.go:252-286``)
     # ------------------------------------------------------------------
     def cleanup_global_accelerator(self, arn: str) -> None:
-        accelerator, listener, endpoint_group = self._list_related(arn)
-        if endpoint_group is not None:
+        accelerator, listeners, endpoint_groups = self._list_related(arn)
+        for endpoint_group in endpoint_groups:
             self.ga.delete_endpoint_group(endpoint_group.endpoint_group_arn)
             klog.infof("EndpointGroup is deleted: %s", endpoint_group.endpoint_group_arn)
-        if listener is not None:
+        for listener in listeners:
             self.ga.delete_listener(listener.listener_arn)
             klog.infof("Listener is deleted: %s", listener.listener_arn)
         if accelerator is not None:
@@ -680,7 +681,7 @@ class AWSDriver:
 
     def _list_related(
         self, arn: str
-    ) -> tuple[Optional[Accelerator], Optional[Listener], Optional[EndpointGroup]]:
+    ) -> tuple[Optional[Accelerator], list[Listener], list[EndpointGroup]]:
         """The reference's ``listRelatedGlobalAccelerator``
         (``global_accelerator.go:273-287``) treats EVERY error as "the
         resource is gone", so a transient throttle during cleanup makes
@@ -688,22 +689,34 @@ class AWSDriver:
         forgotten and the accelerator is orphaned forever (no later
         event re-enqueues a deleted object).  Intent, not bug
         (SURVEY.md §7): only the NotFound codes mean absence; anything
-        else propagates so the reconcile retries."""
+        else propagates so the reconcile retries.
+
+        Teardown deliberately does NOT enforce the exactly-one
+        listener/endpoint-group invariant (``get_listener`` /
+        ``get_endpoint_group`` do, for the ensure path): if out-of-band
+        tampering attached extra listeners or endpoint groups, raising
+        TooMany* here would retry the cleanup forever and the chain
+        could never be torn down — instead everything found is listed
+        and deleted."""
         try:
             accelerator = self.ga.describe_accelerator(arn)
         except AWSAPIError as err:
             if err.code == ERR_ACCELERATOR_NOT_FOUND:
-                return None, None, None
+                return None, [], []
             raise
-        try:
-            listener = self.get_listener(arn)
-        except ListenerNotFoundException:
-            return accelerator, None, None
-        try:
-            endpoint_group = self.get_endpoint_group(listener.listener_arn)
-        except EndpointGroupNotFoundException:
-            return accelerator, listener, None
-        return accelerator, listener, endpoint_group
+        listeners: list[Listener] = self._drain_pages(
+            lambda token: self.ga.list_listeners(arn, 100, token)
+        )
+        endpoint_groups: list[EndpointGroup] = []
+        for listener in listeners:
+            endpoint_groups.extend(
+                self._drain_pages(
+                    lambda token: self.ga.list_endpoint_groups(
+                        listener.listener_arn, 100, token
+                    )
+                )
+            )
+        return accelerator, listeners, endpoint_groups
 
     def _delete_accelerator(self, arn: str) -> None:
         """Disable → poll until DEPLOYED → delete
@@ -914,14 +927,11 @@ class AWSDriver:
             target = parent_domain(target)
 
     def _list_record_sets(self, hosted_zone_id: str) -> list[ResourceRecordSet]:
-        records, token = [], None
-        while True:
-            page, token = self.route53.list_resource_record_sets(
+        return self._drain_pages(
+            lambda token: self.route53.list_resource_record_sets(
                 hosted_zone_id, 300, token
             )
-            records.extend(page)
-            if token is None:
-                return records
+        )
 
     @staticmethod
     def _owned_record_names(

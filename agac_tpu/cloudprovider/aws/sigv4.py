@@ -133,7 +133,12 @@ class CredentialProvider:
     the provider is shared process-wide, and without a TTL a rotated
     credentials file would be ignored until restart (the reference
     re-resolves per reconcile via its ``NewAWS`` calls).  Explicit
-    static ``Credentials`` passed to the constructor never re-resolve.
+    static ``Credentials`` passed to the constructor are honored as-is:
+    non-expiring ones never re-resolve; expiring ones (e.g. session
+    credentials) are served until the expiry margin, after which the
+    resolver is *tried* for fresher credentials — but a failing
+    resolver falls back to the static while it remains actually valid
+    (the margin is an optimization, not a validity boundary).
     """
 
     STATIC_REFRESH_SECONDS = 300.0
@@ -158,7 +163,13 @@ class CredentialProvider:
         with self._lock:
             cached = self._cached
             if cached is self._static and cached is not None:
-                if cached.expiration is None:
+                # explicit static creds: never re-resolve while valid —
+                # non-expiring ones forever, expiring ones until the
+                # expiry margin (only then fall through to the resolver)
+                if (
+                    cached.expiration is None
+                    or cached.expiration - self._clock() > _EXPIRY_MARGIN
+                ):
                     return cached
             elif cached is not None:
                 fresh_enough = (

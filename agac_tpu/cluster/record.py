@@ -27,6 +27,7 @@ Correlation state lives under a lock that is never held across I/O.
 
 from __future__ import annotations
 
+import copy
 import threading
 import time
 from collections import OrderedDict, deque
@@ -178,8 +179,13 @@ class EventRecorder:
                     continue
                 series.dirty = False
                 self._inflight += 1
-                # snapshot what we persist; later bumps re-queue
-                snapshot = series.event
+                # snapshot what we persist — a COPY taken under the
+                # lock, because event() keeps mutating count and
+                # last_timestamp on the live object; serializing the
+                # live reference outside the lock could persist a torn
+                # view (new count, stale lastTimestamp).  Later bumps
+                # re-queue via the dirty flag.
+                snapshot = copy.deepcopy(series.event)
                 created = series.created
             try:
                 if created:

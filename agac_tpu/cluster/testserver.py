@@ -30,6 +30,7 @@ against the real apiserver (``e2e/e2e_test.go:78-98``).
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import urllib.parse
@@ -217,9 +218,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, _status_body(400, "BadRequest", "invalid limit"))
             return
         token = query.get("continue") or ""
-        snapshots = getattr(self.server, "list_snapshots", None)
-        if snapshots is None:
-            snapshots = self.server.list_snapshots = {}  # type: ignore[attr-defined]
+        snapshots = self.server.list_snapshots  # type: ignore[attr-defined]
+        snapshots_lock = self.server.snapshots_lock  # type: ignore[attr-defined]
         if token:
             try:
                 snap_id, offset_str = token.split(":", 1)
@@ -227,7 +227,8 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 self._send(400, _status_body(400, "BadRequest", "invalid continue token"))
                 return
-            snapshot = snapshots.get(snap_id)
+            with snapshots_lock:
+                snapshot = snapshots.get(snap_id)
             if snapshot is None:
                 self._send(
                     410, _status_body(410, "Expired", "continue token expired")
@@ -242,17 +243,24 @@ class _Handler(BaseHTTPRequestHandler):
         page = objs[offset:]
         if limit and len(page) > limit:
             page = page[:limit]
-            snap_id = token.split(":", 1)[0] if token else f"s{id(objs)}-{rv}"
-            # LRU: move-to-end on every touch so an ACTIVE pagination
-            # outlives younger abandoned ones, then evict oldest
-            # (clients holding an evicted token get the 410 above)
-            snapshots.pop(snap_id, None)
-            snapshots[snap_id] = (objs, rv)
-            while len(snapshots) > 32:
-                snapshots.pop(next(iter(snapshots)))
+            snap_id = (
+                token.split(":", 1)[0]
+                if token
+                else f"s{next(self.server.snapshot_counter)}"  # type: ignore[attr-defined]
+            )
+            with snapshots_lock:
+                # LRU: move-to-end on every touch so an ACTIVE
+                # pagination outlives younger abandoned ones, then
+                # evict oldest (clients holding an evicted token get
+                # the 410 above)
+                snapshots.pop(snap_id, None)
+                snapshots[snap_id] = (objs, rv)
+                while len(snapshots) > 32:
+                    snapshots.pop(next(iter(snapshots)))
             metadata["continue"] = f"{snap_id}:{offset + limit}"
         elif token:
-            snapshots.pop(token.split(":", 1)[0], None)  # fully consumed
+            with snapshots_lock:
+                snapshots.pop(token.split(":", 1)[0], None)  # fully consumed
         items = [_full_wire(route.kind, obj) for obj in page]
         body = json.dumps(
             {
@@ -377,6 +385,14 @@ class TestApiServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.cluster = self.cluster  # type: ignore[attr-defined]
         self._httpd.webhooks = {}  # type: ignore[attr-defined]
+        # pagination snapshots: initialized once here (not lazily per
+        # request — the threaded server would race and drop one) and
+        # keyed by a monotonic counter, never id(), which CPython can
+        # reuse after GC and silently resume a stale token against the
+        # wrong snapshot instead of 410ing
+        self._httpd.list_snapshots = {}  # type: ignore[attr-defined]
+        self._httpd.snapshots_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.snapshot_counter = itertools.count(1)  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
 

@@ -124,6 +124,27 @@ class TestCredentialProvider:
         now[0] = 1800.0  # within 5-min margin of the 2000.0 expiry
         assert provider.get().access_key_id == "SECOND"
 
+    def test_static_expiring_credentials_honored_until_margin(self):
+        """Explicitly-passed session credentials (with an expiration)
+        must be served until the expiry margin, not bypassed on the
+        first call (ADVICE r1: the first-call branch only honored
+        non-expiring statics and fell straight through to the
+        resolver)."""
+        now = [1000.0]
+        calls = []
+        static = Credentials("SESSION", "s", session_token="tok", expiration=2000.0)
+        provider = CredentialProvider(
+            static=static,
+            resolver=lambda: calls.append(1) or Credentials("RESOLVED", "r"),
+            clock=lambda: now[0],
+        )
+        assert provider.get() is static  # first call: still valid
+        assert provider.get() is static
+        assert calls == []
+        now[0] = 1800.0  # inside the 5-min margin of 2000.0 expiry
+        assert provider.get().access_key_id == "RESOLVED"
+        assert calls == [1]
+
 
 def test_provider_serves_cached_when_refresh_fails_within_margin():
     now = [1000.0]

@@ -235,6 +235,23 @@ class TestCleanup:
     def test_cleanup_of_missing_accelerator_is_noop(self, backend, driver):
         driver.cleanup_global_accelerator("arn:aws:globalaccelerator::123:accelerator/nope")
 
+    def test_cleanup_tolerates_tampered_extra_listeners_and_groups(self, backend, driver):
+        """Out-of-band tampering that attaches extra listeners or
+        endpoint groups must not wedge teardown: the ensure path's
+        exactly-one invariant (TooManyListeners/TooManyEndpointGroups)
+        is not enforced during cleanup — everything found is deleted
+        (ADVICE r1: previously the TooMany* raise retried forever)."""
+        svc = make_lb_service()
+        arn, _, _ = ensure_service(driver, svc)
+        extra_listener = backend.create_listener(
+            arn, [(8443, 8443)], "TCP", "NONE"
+        )
+        backend.create_endpoint_group(
+            extra_listener.listener_arn, NLB_REGION, []
+        )
+        driver.cleanup_global_accelerator(arn)
+        assert backend.all_accelerator_arns() == []
+
     def test_cleanup_raises_on_transient_describe_error(self, backend, driver):
         """A throttle during cleanup discovery must propagate so the
         reconcile retries — the reference's listRelatedGlobalAccelerator

@@ -106,6 +106,14 @@ class TestRestAgainstHTTP:
             get("/api/v1/services?limit=2&continue=unknown:2")
         assert err.value.code == 410
 
+        # a fully-consumed token is dropped server-side and must 410 on
+        # reuse — never silently resume against a DIFFERENT snapshot
+        # (ADVICE r1: id()-derived snapshot ids could collide after GC)
+        reused = page1["metadata"]["continue"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            get(f"/api/v1/services?limit=2&continue={reused}")
+        assert err.value.code == 410
+
     def test_conflict_over_http(self, server, client):
         client.create("Service", make_lb_service())
         stale = client.get("Service", "default", "web")
