@@ -33,12 +33,19 @@ from typing import Optional
 
 from .. import klog
 from ..apis.endpointgroupbinding import FINALIZER, EndpointGroupBinding
-from ..cloudprovider.aws import aws_error_code, get_lb_name_from_hostname, get_region_from_arn
+from ..cloudprovider.aws import aws_error_code, get_region_from_arn
 from ..cloudprovider.aws.errors import ERR_ENDPOINT_GROUP_NOT_FOUND
 from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
 from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
-from .common import CloudFactory, GLOBAL_REGION, default_cloud_factory, run_workers
+from .common import (
+    CloudFactory,
+    GLOBAL_REGION,
+    default_cloud_factory,
+    lb_name_region_or_warn,
+    make_sync_error_warner,
+    run_workers,
+)
 
 CONTROLLER_AGENT_NAME = "endpoint-group-binding-controller"
 KIND = "EndpointGroupBinding"
@@ -110,6 +117,7 @@ class EndpointGroupBindingController:
             self._key_to_binding,
             self._process_deleted_key,
             self.reconcile,
+            on_sync_error=make_sync_error_warner(self.recorder, self._key_to_binding),
         )
         klog.info("Started workers")
         stop.wait()
@@ -182,7 +190,15 @@ class EndpointGroupBindingController:
         hostnames = self._load_balancer_hostnames(obj)
         arns: dict[str, tuple[str, str]] = {}  # lb arn -> (lb name, region)
         for hostname in hostnames:
-            lb_name, region = get_lb_name_from_hostname(hostname)
+            parsed = lb_name_region_or_warn(self.recorder, obj, hostname)
+            if parsed is None:
+                # abort WITHOUT mutating: dropping the hostname from
+                # the diff would remove its (possibly healthy) endpoint
+                # from the group on a parse error; leave bindings
+                # untouched until the referenced object's status
+                # changes and re-enqueues (no retry — permanent)
+                return Result()
+            lb_name, region = parsed
             regional = self._cloud(region)
             lb = regional.get_load_balancer(lb_name)
             arns[lb.load_balancer_arn] = (lb_name, region)

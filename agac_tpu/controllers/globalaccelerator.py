@@ -21,7 +21,6 @@ from typing import Optional
 
 from .. import apis, klog
 from ..cloudprovider import detect_cloud_provider
-from ..cloudprovider.aws import get_lb_name_from_hostname
 from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import split_meta_namespace_key, meta_namespace_key
 from ..errors import no_retry_errorf
@@ -32,6 +31,8 @@ from .common import (
     annotation_changed,
     default_cloud_factory,
     has_annotation,
+    lb_name_region_or_warn,
+    make_sync_error_warner,
     run_workers,
     unwrap_tombstone,
     was_alb_ingress,
@@ -192,6 +193,7 @@ class GlobalAcceleratorController:
             self._key_to_service,
             self.process_service_delete,
             self.process_service_create_or_update,
+            on_sync_error=make_sync_error_warner(self.recorder, self._key_to_service),
         )
         run_workers(
             f"{CONTROLLER_AGENT_NAME}-ingress",
@@ -201,6 +203,7 @@ class GlobalAcceleratorController:
             self._key_to_ingress,
             self.process_ingress_delete,
             self.process_ingress_create_or_update,
+            on_sync_error=make_sync_error_warner(self.recorder, self._key_to_ingress),
         )
         klog.info("Started workers")
         stop.wait()
@@ -275,7 +278,10 @@ class GlobalAcceleratorController:
             if provider != "aws":
                 klog.warningf("Not implemented for %s", provider)
                 continue
-            lb_name, region = get_lb_name_from_hostname(lb_ingress.hostname)
+            parsed = lb_name_region_or_warn(self.recorder, obj, lb_ingress.hostname)
+            if parsed is None:
+                continue
+            lb_name, region = parsed
             cloud = self._cloud(region)
             if resource == "service":
                 arn, created, retry_after = cloud.ensure_global_accelerator_for_service(

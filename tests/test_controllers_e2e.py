@@ -150,6 +150,64 @@ class TestGlobalAcceleratorServicePath:
         assert accelerators(harness) == []
 
 
+class TestSyncFailureSurfacing:
+    """Unreconcilable items must be visible in ``kubectl get events``
+    (VERDICT r1 #6) — the reference only logs and retries silently."""
+
+    def events_with_reason(self, harness, reason):
+        return [
+            e for e in harness.cluster.list("Event")[0] if e.reason == reason
+        ]
+
+    def test_empty_route53_hostname_annotation_warns_and_cleans_up(self, harness):
+        """Blanking the annotation value means the same as deleting the
+        key — owned records are cleaned up, plus a Warning because it
+        is a likely mistake (the reference spins on GetHostedZone("")
+        forever with no telemetry)."""
+        zone = harness.aws.add_hosted_zone("example.com")
+        svc = make_lb_service(
+            annotations={apis.ROUTE53_HOSTNAME_ANNOTATION: "app.example.com"}
+        )
+        harness.cluster.create("Service", svc)
+        assert wait_until(lambda: len(harness.aws.records_in_zone(zone.id)) == 2)
+
+        obj = harness.cluster.get("Service", "default", "web")
+        obj.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = "  "
+        harness.cluster.update("Service", obj)
+        assert wait_until(
+            lambda: self.events_with_reason(harness, "InvalidAnnotation")
+        )
+        assert wait_until(lambda: harness.aws.records_in_zone(zone.id) == [])
+
+    def test_unparseable_lb_hostname_warns(self, harness):
+        # aws suffix (passes detect_cloud_provider) but no ELB shape
+        svc = make_lb_service(hostname="mystery.us-west-2.amazonaws.com")
+        harness.cluster.create("Service", svc)
+        assert wait_until(
+            lambda: self.events_with_reason(
+                harness, "UnparseableLoadBalancerHostname"
+            )
+        )
+        # the item is NOT stuck retrying: no accelerator, no spin
+        assert accelerators(harness) == []
+
+    def test_persistent_cloud_failure_emits_syncfailing(self, harness):
+        def boom(*args, **kwargs):
+            from agac_tpu.cloudprovider.aws.fake_backend import AWSAPIError
+
+            raise AWSAPIError("InternalServiceErrorException", "persistent outage")
+
+        harness.aws.create_accelerator = boom
+        harness.cluster.create("Service", make_lb_service())
+        # after SYNC_WARNING_RETRY_THRESHOLD rate-limited requeues
+        # (~5 s of exponential backoff) the Warning appears
+        assert wait_until(
+            lambda: self.events_with_reason(harness, "SyncFailing"), timeout=20
+        )
+        event = self.events_with_reason(harness, "SyncFailing")[0]
+        assert "persistent outage" in event.message
+
+
 class TestGlobalAcceleratorIngressPath:
     def test_ingress_create_and_cleanup(self, harness):
         ing = make_alb_ingress()

@@ -187,3 +187,63 @@ def test_delete_path_error_requeues(queue):
 
     run_one(queue, key_to_obj, process_delete, lambda o: pytest.fail())
     assert any(c[0] == "add_rate_limited" for c in queue.calls)
+
+
+class TestOnSyncErrorHook:
+    """The observability hook (VERDICT r1 #6): fired after the retry
+    policy with (key, err, num_requeues, permanent); contained; silent
+    on success."""
+
+    def test_retryable_error_reports_requeues(self, queue):
+        seen = []
+        queue.add("ns/fail")
+
+        def process(obj):
+            raise RuntimeError("aws is down")
+
+        assert process_next_work_item(
+            queue, lambda k: Obj(k, {}), lambda k: pytest.fail(), process,
+            lambda *a: seen.append(a),
+        )
+        assert len(seen) == 1
+        key, err, requeues, permanent = seen[0]
+        assert key == "ns/fail" and "aws is down" in str(err)
+        assert requeues == 1 and permanent is False
+
+    def test_no_retry_error_reports_permanent(self, queue):
+        seen = []
+        queue.add("ns/bad")
+
+        def process(obj):
+            raise NoRetryError("config error")
+
+        assert process_next_work_item(
+            queue, lambda k: Obj(k, {}), lambda k: pytest.fail(), process,
+            lambda *a: seen.append(a),
+        )
+        assert seen[0][3] is True
+        assert not any(c[0] == "add_rate_limited" for c in queue.calls)
+
+    def test_success_does_not_fire(self, queue):
+        seen = []
+        queue.add("ns/ok")
+        assert process_next_work_item(
+            queue, lambda k: Obj(k, {}), lambda k: pytest.fail(),
+            lambda obj: Result(), lambda *a: seen.append(a),
+        )
+        assert seen == []
+
+    def test_hook_exception_is_contained(self, queue):
+        queue.add("ns/fail")
+
+        def process(obj):
+            raise RuntimeError("boom")
+
+        def bad_hook(*a):
+            raise ValueError("hook bug")
+
+        # neither the worker nor the retry policy is disturbed
+        assert process_next_work_item(
+            queue, lambda k: Obj(k, {}), lambda k: pytest.fail(), process, bad_hook
+        )
+        assert any(c[0] == "add_rate_limited" for c in queue.calls)
