@@ -215,6 +215,22 @@ def _hmac(key: bytes, message: str) -> bytes:
     return hmac.new(key, message.encode(), hashlib.sha256).digest()
 
 
+def derive_signing_key(
+    secret_access_key: str, date_stamp: str, region: str, service: str
+) -> bytes:
+    """The SigV4 key-derivation chain
+    ``HMAC(HMAC(HMAC(HMAC("AWS4"+secret, date), region), service), "aws4_request")``.
+    Validated byte-for-byte against AWS's published derivation examples
+    (``tests/test_sigv4_aws_vectors.py``)."""
+    return _hmac(
+        _hmac(
+            _hmac(_hmac(f"AWS4{secret_access_key}".encode(), date_stamp), region),
+            service,
+        ),
+        "aws4_request",
+    )
+
+
 def _canonical_query(query: str) -> str:
     pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
     encoded = [
@@ -270,15 +286,8 @@ def sign_request(
             _sha256_hex(canonical_request.encode()),
         ]
     )
-    key = _hmac(
-        _hmac(
-            _hmac(
-                _hmac(f"AWS4{credentials.secret_access_key}".encode(), date_stamp),
-                region,
-            ),
-            service,
-        ),
-        "aws4_request",
+    key = derive_signing_key(
+        credentials.secret_access_key, date_stamp, region, service
     )
     signature = hmac.new(key, string_to_sign.encode(), hashlib.sha256).hexdigest()
     signed["Authorization"] = (

@@ -11,10 +11,12 @@ Route53 hostname-annotation pair (``route53/controller.go:243-252``).
 from __future__ import annotations
 
 import threading
+import time
+from collections import OrderedDict
 from typing import Any, Callable, Optional
 
 from .. import apis, klog
-from ..cloudprovider.aws import AWSDriver
+from ..cloudprovider.aws import AWSDriver, get_lb_name_from_hostname
 from ..cluster.informer import Tombstone
 from ..reconcile import RateLimitingQueue, process_next_work_item
 
@@ -119,10 +121,17 @@ def run_workers(
 # invisible to ``kubectl get events``)
 # ---------------------------------------------------------------------------
 
-# after this many rate-limited requeues of the same item, start
-# warning: with the default 5 ms base / factor-2 backoff the item has
-# been failing for ~10 s and is clearly not transient
+# after this many consecutive reconcile FAILURES of the same item,
+# start warning: with the default 5 ms base / factor-2 backoff the
+# item has been failing for ~5 s and is clearly not transient
 SYNC_WARNING_RETRY_THRESHOLD = 10
+
+# failures further apart than this are not "the same incident": the
+# consecutive-failure count restarts (matches the recorder's
+# aggregation window)
+SYNC_WARNING_FAILURE_WINDOW = 600.0
+
+_SYNC_WARNING_MAX_TRACKED = 4096
 
 
 def lb_name_region_or_warn(recorder, obj, hostname: str):
@@ -131,8 +140,6 @@ def lb_name_region_or_warn(recorder, obj, hostname: str):
     a malformed LB hostname is permanent for that status entry —
     retrying can't fix it (the reference requeues forever with no
     telemetry, VERDICT r1 #6); a status update re-enqueues."""
-    from ..cloudprovider.aws import get_lb_name_from_hostname
-
     try:
         return get_lb_name_from_hostname(hostname)
     except ValueError as err:
@@ -149,14 +156,35 @@ def make_sync_error_warner(recorder, key_to_obj, threshold=SYNC_WARNING_RETRY_TH
     """Build an ``on_sync_error`` hook that emits Warning Events for
     unreconcilable items: permanent (NoRetry) errors warn immediately
     with reason ``SyncFailedPermanently``; retryable errors warn with
-    ``SyncFailing`` once the item has been requeued ``threshold``
-    times, then on every further retry — the recorder aggregates the
+    ``SyncFailing`` once the item has failed ``threshold`` times in a
+    row, then on every further retry — the recorder aggregates the
     stable message into one Event whose count keeps climbing, and its
-    spam filter bounds the persistence rate."""
+    spam filter bounds the persistence rate.
+
+    The warner counts actual hook invocations (= reconcile failures)
+    rather than trusting ``queue.num_requeues``, which is also bumped
+    by ordinary notification enqueues (both here and in the reference,
+    ``AddRateLimited`` on every event — ``controller.go:182``) and
+    would warn early for a frequently-updated object.  Failures more
+    than ``SYNC_WARNING_FAILURE_WINDOW`` apart restart the count."""
+    lock = threading.Lock()
+    failures: "OrderedDict[str, tuple[int, float]]" = OrderedDict()
 
     def warn(key: str, err: Exception, requeues: int, permanent: bool) -> None:
-        if not permanent and requeues < threshold:
-            return
+        if permanent:
+            with lock:
+                failures.pop(key, None)
+        else:
+            now = time.monotonic()
+            with lock:
+                count, last = failures.get(key, (0, -SYNC_WARNING_FAILURE_WINDOW))
+                count = count + 1 if now - last < SYNC_WARNING_FAILURE_WINDOW else 1
+                failures[key] = (count, now)
+                failures.move_to_end(key)
+                while len(failures) > _SYNC_WARNING_MAX_TRACKED:
+                    failures.popitem(last=False)
+            if count < threshold:
+                return
         try:
             obj = key_to_obj(key)
         except Exception:

@@ -191,6 +191,33 @@ class TestSyncFailureSurfacing:
         # the item is NOT stuck retrying: no accelerator, no spin
         assert accelerators(harness) == []
 
+    def test_warner_counts_failures_not_queue_requeues(self):
+        """Notification enqueues also bump num_requeues (here and in
+        the reference — AddRateLimited on every event), so the warner
+        must count its own invocations: an object updated many times
+        then failing once must NOT warn."""
+        from agac_tpu.cluster import FakeCluster
+        from agac_tpu.cluster.record import EventRecorder
+        from agac_tpu.controllers.common import make_sync_error_warner
+
+        cluster = FakeCluster()
+        svc = make_lb_service(name="flaky")
+        cluster.create("Service", svc)
+        recorder = EventRecorder(cluster, component="test")
+        warn = make_sync_error_warner(recorder, lambda key: svc, threshold=3)
+
+        # requeues already inflated to 50 by notifications: first two
+        # real failures stay quiet, third warns
+        warn("default/flaky", RuntimeError("x"), 50, False)
+        warn("default/flaky", RuntimeError("x"), 51, False)
+        recorder.flush()
+        assert not [e for e in cluster.list("Event")[0] if e.type == "Warning"]
+        warn("default/flaky", RuntimeError("x"), 52, False)
+        recorder.flush()
+        warnings = [e for e in cluster.list("Event")[0] if e.type == "Warning"]
+        assert len(warnings) == 1 and warnings[0].reason == "SyncFailing"
+        recorder.shutdown()
+
     def test_persistent_cloud_failure_emits_syncfailing(self, harness):
         def boom(*args, **kwargs):
             from agac_tpu.cloudprovider.aws.fake_backend import AWSAPIError

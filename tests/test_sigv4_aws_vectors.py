@@ -1,0 +1,77 @@
+"""Byte-level SigV4 validation against AWS's OWN published vectors
+(VERDICT r1 next#2: "assert byte-level SigV4 signatures against AWS's
+published test vectors").
+
+These constants were not produced by this repo's code — they are
+transcribed from AWS's Signature Version 4 documentation ("Deriving
+the signing key" examples, the complete IAM ListUsers signing
+walkthrough) and the aws-sig-v4-test-suite (get-vanilla /
+get-vanilla-query-order-key-case, asserted in
+``tests/test_real_aws_backend.py``).  Agreement here means the signing
+path matches an implementation the author didn't write; a wrong
+canonicalization, derivation chain, or scope string fails these
+byte-for-byte.
+
+The reference delegates all of this to aws-sdk-go-v2 (SURVEY.md §2
+row 12); this repo hand-rolls it (``sigv4.py``), so the external
+vectors carry the correctness burden the SDK carried there.
+"""
+
+import datetime
+
+from agac_tpu.cloudprovider.aws.sigv4 import (
+    Credentials,
+    derive_signing_key,
+    sign_request,
+)
+
+# The aws-sig-v4-test-suite / AWS docs example credentials.
+ACCESS_KEY = "AKIDEXAMPLE"
+SECRET_KEY = "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+
+
+class TestKeyDerivationVectors:
+    """AWS docs, "Deriving the signing key" — published example
+    outputs of the HMAC chain."""
+
+    def test_derivation_example_20150830_iam(self):
+        key = derive_signing_key(SECRET_KEY, "20150830", "us-east-1", "iam")
+        assert key.hex() == (
+            "c4afb1cc5771d871763a393e44b703571b55cc28424d1a5e86da6ed3c154a4b9"
+        )
+
+    def test_derivation_example_20120215_iam(self):
+        key = derive_signing_key(SECRET_KEY, "20120215", "us-east-1", "iam")
+        assert key.hex() == (
+            "f4780e2d9f65fa895f9c67b32ce1baf0b0d8a43505a000a1a9e090d414db404d"
+        )
+
+
+class TestCompleteSigningExample:
+    """AWS docs, the complete SigV4 walkthrough: GET ListUsers against
+    IAM at 20150830T123600Z.  The published final signature commits to
+    every intermediate (canonical request, hashed canonical request
+    f536975d06c0309214f805bb90ccff089219ecd68b2577efef23edd43b7e1a59,
+    string to sign, signing key)."""
+
+    def test_iam_list_users_signature(self):
+        now = datetime.datetime(2015, 8, 30, 12, 36, 0, tzinfo=datetime.timezone.utc)
+        signed = sign_request(
+            "GET",
+            "https://iam.amazonaws.com/?Action=ListUsers&Version=2010-05-08",
+            {"Content-Type": "application/x-www-form-urlencoded; charset=utf-8"},
+            b"",
+            "iam",
+            "us-east-1",
+            Credentials(ACCESS_KEY, SECRET_KEY),
+            now=now,
+        )
+        assert signed["Authorization"] == (
+            "AWS4-HMAC-SHA256 "
+            "Credential=AKIDEXAMPLE/20150830/us-east-1/iam/aws4_request, "
+            "SignedHeaders=content-type;host;x-amz-date, "
+            "Signature="
+            "5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e06b5924a6f2b5d7"
+        )
+        assert signed["X-Amz-Date"] == "20150830T123600Z"
+        assert signed["Host"] == "iam.amazonaws.com"
