@@ -31,6 +31,22 @@ manifests:
 check-manifests: manifests
 	@test -z "$$(git status --porcelain config/ $(CHART_DIR)/crds/)" || { git status config/ $(CHART_DIR)/crds/; exit 1; }
 
+# Opt-in full-loop e2e against REAL AWS (never in CI): needs
+# credentials + E2E_LB_HOSTNAME (existing NLB/ALB DNS name), optional
+# E2E_ROUTE53_HOSTNAME.  Creates one Global Accelerator and deletes it
+# again (~$0.025/hr pro-rated; see tests/test_real_aws_e2e.py for the
+# full contract and leak-cleanup notes).  The analog of the
+# reference's local_e2e/ suite.
+.PHONY: e2e-aws
+e2e-aws:
+	E2E_AWS=1 $(PYTHON) -m pytest tests/test_real_aws_e2e.py -q -s
+
+# Validate the e2e-aws harness itself without credentials (fake
+# backend, tight polling) — also runs as part of 'make test'
+.PHONY: e2e-aws-smoke
+e2e-aws-smoke:
+	E2E_AWS=smoke $(PYTHON) -m pytest tests/test_real_aws_e2e.py -q
+
 .PHONY: bench
 bench:
 	$(PYTHON) bench.py

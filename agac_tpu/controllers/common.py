@@ -92,7 +92,7 @@ def run_workers(
     key_to_obj,
     process_delete,
     process_create_or_update,
-    on_sync_error=None,
+    on_sync_result=None,
 ) -> list[threading.Thread]:
     """Launch ``threadiness`` worker threads looping
     ``process_next_work_item`` until queue shutdown (the analog of
@@ -102,7 +102,7 @@ def run_workers(
     def loop():
         while process_next_work_item(
             queue, key_to_obj, process_delete, process_create_or_update,
-            on_sync_error,
+            on_sync_result,
         ):
             if stop.is_set():
                 break
@@ -153,7 +153,7 @@ def lb_name_region_or_warn(recorder, obj, hostname: str):
 
 
 def make_sync_error_warner(recorder, key_to_obj, threshold=SYNC_WARNING_RETRY_THRESHOLD):
-    """Build an ``on_sync_error`` hook that emits Warning Events for
+    """Build an ``on_sync_result`` hook that emits Warning Events for
     unreconcilable items: permanent (NoRetry) errors warn immediately
     with reason ``SyncFailedPermanently``; retryable errors warn with
     ``SyncFailing`` once the item has failed ``threshold`` times in a
@@ -161,19 +161,27 @@ def make_sync_error_warner(recorder, key_to_obj, threshold=SYNC_WARNING_RETRY_TH
     stable message into one Event whose count keeps climbing, and its
     spam filter bounds the persistence rate.
 
-    The warner counts actual hook invocations (= reconcile failures)
-    rather than trusting ``queue.num_requeues``, which is also bumped
-    by ordinary notification enqueues (both here and in the reference,
+    The warner counts actual failure invocations (a successful sync —
+    ``err is None`` — resets the streak) rather than trusting
+    ``queue.num_requeues``, which is also bumped by ordinary
+    notification enqueues (both here and in the reference,
     ``AddRateLimited`` on every event — ``controller.go:182``) and
     would warn early for a frequently-updated object.  Failures more
-    than ``SYNC_WARNING_FAILURE_WINDOW`` apart restart the count."""
+    than ``SYNC_WARNING_FAILURE_WINDOW`` apart restart the count, so a
+    key whose object disappears doesn't pin stale state."""
     lock = threading.Lock()
     failures: "OrderedDict[str, tuple[int, float]]" = OrderedDict()
 
-    def warn(key: str, err: Exception, requeues: int, permanent: bool) -> None:
-        if permanent:
+    def warn(
+        key: str, err: "Exception | None", requeues: int, permanent: bool
+    ) -> None:
+        if err is None or permanent:
+            # success ends the streak; permanent errors don't count
+            # toward one either (they warn on their own below)
             with lock:
                 failures.pop(key, None)
+            if err is None:
+                return
         else:
             now = time.monotonic()
             with lock:

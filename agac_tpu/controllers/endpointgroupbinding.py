@@ -117,7 +117,7 @@ class EndpointGroupBindingController:
             self._key_to_binding,
             self._process_deleted_key,
             self.reconcile,
-            on_sync_error=make_sync_error_warner(self.recorder, self._key_to_binding),
+            on_sync_result=make_sync_error_warner(self.recorder, self._key_to_binding),
         )
         klog.info("Started workers")
         stop.wait()

@@ -158,7 +158,7 @@ class Route53Controller:
             self._key_to_service,
             self.process_service_delete,
             self.process_service_create_or_update,
-            on_sync_error=make_sync_error_warner(self.recorder, self._key_to_service),
+            on_sync_result=make_sync_error_warner(self.recorder, self._key_to_service),
         )
         run_workers(
             f"{CONTROLLER_AGENT_NAME}-ingress",
@@ -168,7 +168,7 @@ class Route53Controller:
             self._key_to_ingress,
             self.process_ingress_delete,
             self.process_ingress_create_or_update,
-            on_sync_error=make_sync_error_warner(self.recorder, self._key_to_ingress),
+            on_sync_result=make_sync_error_warner(self.recorder, self._key_to_ingress),
         )
         klog.info("Started workers")
         stop.wait()

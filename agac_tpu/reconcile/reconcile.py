@@ -35,10 +35,11 @@ from .workqueue import RateLimitingQueue
 KeyToObjFunc = Callable[[str], Any]
 ProcessDeleteFunc = Callable[[str], Result]
 ProcessCreateOrUpdateFunc = Callable[[Any], Result]
-# (key, error, num_requeues, permanent) — observability hook fired
-# after the retry policy has been applied; ``permanent`` is True for
-# NoRetry errors (the item will NOT be retried).
-SyncErrorFunc = Callable[[str, Exception, int, bool], None]
+# (key, error_or_None, num_requeues, permanent) — observability hook
+# fired after the retry policy has been applied.  ``error`` is None on
+# a successful sync (so streak-tracking hooks can reset); ``permanent``
+# is True for NoRetry errors (the item will NOT be retried).
+SyncResultFunc = Callable[[str, "Exception | None", int, bool], None]
 
 
 def process_next_work_item(
@@ -46,7 +47,7 @@ def process_next_work_item(
     key_to_obj: KeyToObjFunc,
     process_delete: ProcessDeleteFunc,
     process_create_or_update: ProcessCreateOrUpdateFunc,
-    on_sync_error: SyncErrorFunc | None = None,
+    on_sync_result: SyncResultFunc | None = None,
 ) -> bool:
     """Process one queue item; False only when the queue shut down.
 
@@ -55,7 +56,7 @@ def process_next_work_item(
     logged and swallowed so the worker loop keeps running (crash
     containment, the analog of ``utilruntime.HandleError``).
 
-    ``on_sync_error`` (absent in the reference, which only logs —
+    ``on_sync_result`` (absent in the reference, which only logs —
     VERDICT r1 #6) lets controllers surface failing items to users,
     e.g. as Warning Events; it observes, never alters, the retry
     policy, and its own exceptions are contained.
@@ -66,7 +67,7 @@ def process_next_work_item(
     try:
         _reconcile_handler(
             item, queue, key_to_obj, process_delete, process_create_or_update,
-            on_sync_error,
+            on_sync_result,
         )
     except Exception as err:  # containment: a bad item must not kill the worker
         klog.errorf("unhandled error reconciling %r: %s", item, err)
@@ -81,7 +82,7 @@ def _reconcile_handler(
     key_to_obj: KeyToObjFunc,
     process_delete: ProcessDeleteFunc,
     process_create_or_update: ProcessCreateOrUpdateFunc,
-    on_sync_error: SyncErrorFunc | None = None,
+    on_sync_result: SyncResultFunc | None = None,
 ) -> None:
     if not isinstance(key, str):
         queue.forget(key)
@@ -100,21 +101,29 @@ def _reconcile_handler(
         else:
             queue.add_rate_limited(key)
             klog.errorf("error syncing %r, and requeued: %s", key, err)
-        if on_sync_error is not None:
-            try:
-                on_sync_error(key, err, queue.num_requeues(key), permanent)
-            except Exception as hook_err:
-                klog.errorf("on_sync_error hook failed for %r: %s", key, hook_err)
+        _notify(on_sync_result, key, err, queue.num_requeues(key), permanent)
     elif res.requeue_after > 0:
         queue.forget(key)
         queue.add_after(key, res.requeue_after)
         klog.infof("Successfully synced %r, but requeued after %.1fs", key, res.requeue_after)
+        _notify(on_sync_result, key, None, 0, False)
     elif res.requeue:
         queue.add_rate_limited(key)
         klog.infof("Successfully synced %r, but requeued", key)
+        _notify(on_sync_result, key, None, 0, False)
     else:
         queue.forget(key)
         klog.infof("Successfully synced %r", key)
+        _notify(on_sync_result, key, None, 0, False)
+
+
+def _notify(hook, key, err, requeues, permanent) -> None:
+    if hook is None:
+        return
+    try:
+        hook(key, err, requeues, permanent)
+    except Exception as hook_err:
+        klog.errorf("on_sync_result hook failed for %r: %s", key, hook_err)
 
 
 def _dispatch(

@@ -216,6 +216,14 @@ class TestSyncFailureSurfacing:
         recorder.flush()
         warnings = [e for e in cluster.list("Event")[0] if e.type == "Warning"]
         assert len(warnings) == 1 and warnings[0].reason == "SyncFailing"
+
+        # a SUCCESS resets the streak: two more failures stay quiet
+        warn("default/flaky", None, 0, False)
+        warn("default/flaky", RuntimeError("x"), 60, False)
+        warn("default/flaky", RuntimeError("x"), 61, False)
+        recorder.flush()
+        warnings = [e for e in cluster.list("Event")[0] if e.type == "Warning"]
+        assert len(warnings) == 1  # no new Warning after the reset
         recorder.shutdown()
 
     def test_persistent_cloud_failure_emits_syncfailing(self, harness):

@@ -1,0 +1,254 @@
+"""Opt-in full-loop e2e against REAL AWS — the analog of the
+reference's ``local_e2e/`` suite (``local_e2e/e2e_test.go:257-385``,
+``local_e2e/README.md``): drive an annotated Service through the real
+controllers until a real accelerator → listener → endpoint-group chain
+(and optionally Route53 alias records) converges, then delete and poll
+until AWS is clean.  The production driver is reused as the test
+oracle, exactly as the reference reuses its ``cloudprovider/aws``
+(``e2e_test.go:13,119-122``).
+
+NEVER runs in CI.  Gated on ``E2E_AWS=1`` plus credentials; the
+Kubernetes side is the in-process fake cluster (the real-apiserver
+tier lives in ``tests/test_kind_e2e.py``) because the subject under
+test here is the REAL AWS wire path: SigV4 signing, GA JSON-RPC,
+ELBv2/Route53 XML, pagination, error mapping — everything
+``real_backend.py`` encodes from documentation rather than from an SDK.
+
+Environment contract (mirrors ``local_e2e/e2e_test.go:46-58``):
+
+- ``E2E_AWS=1``                 — opt-in gate.
+- AWS credentials               — any mechanism the production chain
+                                  resolves (env keys, IRSA, shared
+                                  credentials file).
+- ``E2E_LB_HOSTNAME``           — DNS name of an EXISTING NLB/ALB in
+                                  your account (the reference gets one
+                                  from its kops cluster; here you
+                                  bring your own).
+- ``E2E_ROUTE53_HOSTNAME``      — optional: hostname inside a hosted
+                                  zone you own; enables the Route53
+                                  assertions (comma-separated ok).
+- ``E2E_CLUSTER_NAME``          — ownership-tag namespace (default
+                                  ``agac-e2e``).
+
+Cost: a Global Accelerator bills ~$0.025/hour plus data transfer from
+creation until deletion; a complete run creates exactly one and
+deletes it again within the run (typically < 15 min → well under
+$0.01), plus a handful of Route53 API calls (free) and two records
+(deleted again).  A FAILED run can leave the accelerator behind —
+clean up with the AWS console or
+``aws globalaccelerator list-accelerators`` if the teardown assertions
+did not complete.
+
+Run: ``make e2e-aws`` (or
+``E2E_AWS=1 E2E_LB_HOSTNAME=... python -m pytest tests/test_real_aws_e2e.py -s``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from agac_tpu import apis
+
+# E2E_AWS=1      → real AWS (credentials + E2E_LB_HOSTNAME required).
+# E2E_AWS=smoke  → same harness against the in-repo fake backend with
+#                  tight polling: verifies the HARNESS logic (fixture
+#                  wiring, oracle polling, teardown ordering) without
+#                  credentials, so the real tier can't rot unnoticed.
+#                  tests/test_real_aws_harness_smoke.py runs this in CI.
+E2E_MODE = os.environ.get("E2E_AWS", "")
+SMOKE = E2E_MODE == "smoke"
+
+pytestmark = pytest.mark.skipif(
+    E2E_MODE not in ("1", "smoke"),
+    reason="real-AWS e2e is opt-in: set E2E_AWS=1 plus credentials and "
+    "E2E_LB_HOSTNAME (see module docstring for the full contract and cost)",
+)
+
+# reference polling budgets: 10 s interval, 5-10 min timeouts
+# (``local_e2e/e2e_test.go:102,264,317,355,372``)
+POLL_INTERVAL = 0.05 if SMOKE else 10.0
+CONVERGE_TIMEOUT = 10.0 if SMOKE else 600.0
+ROUTE53_TIMEOUT = 10.0 if SMOKE else 300.0
+CLEANUP_TIMEOUT = 10.0 if SMOKE else 600.0
+
+
+def poll_until(description: str, pred, timeout: float):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        print(f"waiting: {description}")
+        time.sleep(POLL_INTERVAL)
+    assert pred(), f"timed out after {timeout}s waiting for {description}"
+
+
+@pytest.fixture(scope="module")
+def env():
+    if SMOKE:
+        from .fixtures import NLB_HOSTNAME
+
+        return {
+            "lb_hostname": NLB_HOSTNAME,
+            "route53_hostname": "app.example.com",
+            "cluster_name": "agac-e2e",
+        }
+    lb_hostname = os.environ.get("E2E_LB_HOSTNAME")
+    assert lb_hostname, "E2E_LB_HOSTNAME is required (existing NLB/ALB DNS name)"
+    return {
+        "lb_hostname": lb_hostname,
+        "route53_hostname": os.environ.get("E2E_ROUTE53_HOSTNAME", ""),
+        "cluster_name": os.environ.get("E2E_CLUSTER_NAME", "agac-e2e"),
+    }
+
+
+@pytest.fixture(scope="module")
+def stack(env):
+    """Manager + controllers on the fake cluster, production cloud
+    factory (real SigV4 backend) — the deployment the reference makes
+    in-cluster (``local_e2e/pkg/fixtures/manager.go:16-108``), run
+    in-process instead."""
+    from agac_tpu.cloudprovider.aws.factory import real_cloud_factory
+    from agac_tpu.cluster import FakeCluster
+    from agac_tpu.controllers.endpointgroupbinding import EndpointGroupBindingConfig
+    from agac_tpu.controllers.globalaccelerator import GlobalAcceleratorConfig
+    from agac_tpu.controllers.route53 import Route53Config
+    from agac_tpu.manager import ControllerConfig, Manager
+
+    if SMOKE:
+        from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+        from agac_tpu.cloudprovider.aws import get_lb_name_from_hostname
+
+        backend = FakeAWSBackend()
+        lb_name, lb_region = get_lb_name_from_hostname(env["lb_hostname"])
+        backend.add_load_balancer(lb_name, lb_region, env["lb_hostname"])
+        backend.add_hosted_zone("example.com")
+        factory = lambda region: AWSDriver(  # noqa: E731
+            backend, backend, backend, poll_interval=0.01, poll_timeout=2.0,
+            lb_not_active_retry=0.05, accelerator_missing_retry=0.05,
+        )
+    else:
+        assert os.environ.get("AGAC_CLOUD") != "fake", (
+            "unset AGAC_CLOUD: this tier exists to exercise the REAL backend"
+        )
+        factory = real_cloud_factory
+    name = env["cluster_name"]
+    cluster = FakeCluster()
+    stop = threading.Event()
+    Manager(resync_period=0.3 if SMOKE else 30.0).run(
+        cluster,
+        ControllerConfig(
+            global_accelerator=GlobalAcceleratorConfig(cluster_name=name),
+            route53=Route53Config(cluster_name=name),
+            endpoint_group_binding=EndpointGroupBindingConfig(),
+        ),
+        stop,
+        cloud_factory=factory,
+        block=False,
+    )
+    yield {"cluster": cluster, "factory": factory}
+    stop.set()
+
+
+def _oracle(factory):
+    """The production driver as oracle, GA/Route53 pinned global."""
+    from agac_tpu.controllers.common import GLOBAL_REGION
+
+    return factory(GLOBAL_REGION)
+
+
+def test_service_chain_converges_and_cleans_up(env, stack):
+    from agac_tpu.cloudprovider.aws import get_lb_name_from_hostname
+    from agac_tpu.cloudprovider.aws.driver import Route53OwnerValue
+    from agac_tpu.cloudprovider.aws.errors import (
+        EndpointGroupNotFoundException,
+        ListenerNotFoundException,
+    )
+
+    from .fixtures import make_lb_service
+
+    cluster = stack["cluster"]
+    factory = stack["factory"]
+    cloud = _oracle(factory)
+
+    lb_name, lb_region = get_lb_name_from_hostname(env["lb_hostname"])
+    lb = factory(lb_region).get_load_balancer(lb_name)
+
+    annotations = {}
+    hostnames = []
+    if env["route53_hostname"]:
+        annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = env["route53_hostname"]
+        hostnames = env["route53_hostname"].split(",")
+
+    svc = make_lb_service(
+        name="agac-e2e-test", hostname=env["lb_hostname"], annotations=annotations
+    )
+    cluster.create("Service", svc)
+
+    def list_owned():
+        return cloud.list_global_accelerator_by_resource(
+            env["cluster_name"], "service", "default", "agac-e2e-test"
+        )
+
+    try:
+        # --- converge: accelerator → listener → endpoint group whose
+        # endpoint is OUR load balancer (``e2e_test.go:257-303``)
+        def chain_converged():
+            for accelerator in list_owned():
+                try:
+                    listener = cloud.get_listener(accelerator.accelerator_arn)
+                    group = cloud.get_endpoint_group(listener.listener_arn)
+                except (ListenerNotFoundException, EndpointGroupNotFoundException):
+                    return False
+                if any(
+                    d.endpoint_id == lb.load_balancer_arn
+                    for d in group.endpoint_descriptions
+                ):
+                    return True
+            return False
+
+        poll_until("accelerator chain", chain_converged, CONVERGE_TIMEOUT)
+
+        # --- Route53 alias records point at the accelerator
+        # (``e2e_test.go:305-340``)
+        if hostnames:
+            accelerator = list_owned()[0]
+            owner = Route53OwnerValue(
+                env["cluster_name"], "service", "default", "agac-e2e-test"
+            )
+
+            def records_converged():
+                for h in hostnames:
+                    zone = cloud.get_hosted_zone(h)
+                    records = cloud.find_owned_a_record_sets(zone, owner)
+                    if not any(
+                        r.alias_target is not None
+                        and r.alias_target.dns_name == accelerator.dns_name + "."
+                        for r in records
+                    ):
+                        return False
+                return True
+
+            poll_until("route53 alias records", records_converged, ROUTE53_TIMEOUT)
+    finally:
+        # --- teardown: delete the Service, poll AWS until clean
+        # (``e2e_test.go:342-385``); runs even when convergence failed
+        # so a broken run still tries to avoid leaking an accelerator
+        cluster.delete("Service", "default", "agac-e2e-test")
+
+    poll_until("accelerator cleanup", lambda: list_owned() == [], CLEANUP_TIMEOUT)
+    if hostnames:
+        owner = Route53OwnerValue(
+            env["cluster_name"], "service", "default", "agac-e2e-test"
+        )
+
+        def records_gone():
+            return all(
+                cloud.find_owned_a_record_sets(cloud.get_hosted_zone(h), owner) == []
+                for h in hostnames
+            )
+
+        poll_until("route53 cleanup", records_gone, CLEANUP_TIMEOUT)

@@ -1,0 +1,43 @@
+"""CI guard for the opt-in real-AWS harness: run
+``tests/test_real_aws_e2e.py`` in smoke mode (fake backend, tight
+polling) in a subprocess so the harness's fixture wiring, oracle
+polling, and teardown ordering can't rot between the rare real runs.
+The real tier itself never runs in CI (cost + credentials —
+reference ``local_e2e/README.md``)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_real_aws_harness_passes_in_smoke_mode():
+    env = dict(os.environ, E2E_AWS="smoke")
+    env.pop("E2E_LB_HOSTNAME", None)
+    result = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_real_aws_e2e.py", "-q"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "1 passed" in result.stdout
+
+
+def test_real_aws_harness_skips_by_default():
+    env = dict(os.environ)
+    env.pop("E2E_AWS", None)
+    result = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_real_aws_e2e.py", "-q"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "1 skipped" in result.stdout

@@ -224,14 +224,16 @@ class TestOnSyncErrorHook:
         assert seen[0][3] is True
         assert not any(c[0] == "add_rate_limited" for c in queue.calls)
 
-    def test_success_does_not_fire(self, queue):
+    def test_success_fires_with_none_error(self, queue):
+        """Successful syncs notify with err=None so streak-tracking
+        hooks (the SyncFailing warner) can reset their counts."""
         seen = []
         queue.add("ns/ok")
         assert process_next_work_item(
             queue, lambda k: Obj(k, {}), lambda k: pytest.fail(),
             lambda obj: Result(), lambda *a: seen.append(a),
         )
-        assert seen == []
+        assert seen == [("ns/ok", None, 0, False)]
 
     def test_hook_exception_is_contained(self, queue):
         queue.add("ns/fail")
