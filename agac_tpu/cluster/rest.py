@@ -152,6 +152,27 @@ class RestClusterClient(ClusterClient):
                 )
         return status, payload
 
+    def raw_request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        content_type: str = "application/json",
+        timeout: float = 30.0,
+    ) -> tuple[int, bytes]:
+        """Untyped request sharing this client's base URL, TLS and
+        credentials — the escape hatch the dynamic client
+        (``cluster/dynamic.py``) builds on for kinds outside
+        ``KIND_REGISTRY``.  Returns ``(status, body)`` without raising."""
+        url = f"{self.base_url}/{path.lstrip('/')}"
+        headers = {"Accept": "application/json"}
+        token = self._token_provider() if self._token_provider else self._token
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        if body is not None:
+            headers["Content-Type"] = content_type
+        return self._transport(method, url, headers, body, timeout, False)
+
     # ------------------------------------------------------------------
     # paths and serde
     # ------------------------------------------------------------------
