@@ -47,6 +47,23 @@ e2e-aws:
 e2e-aws-smoke:
 	E2E_AWS=smoke $(PYTHON) -m pytest tests/test_real_aws_e2e.py -q
 
+# Opt-in real-apiserver e2e (the analog of the reference's kind CI
+# tier, .github/workflows/e2e.yml): needs kind + docker + kubectl.
+# hack/kind-e2e.sh provisions the cluster, generates webhook TLS, and
+# runs tests/test_kind_e2e.py with E2E_KIND=1.  See
+# KIND_E2E_RESULTS.md for recorded runs and environment caveats.
+K8S_VERSION ?= 1.31.0
+
+.PHONY: e2e-kind
+e2e-kind:
+	K8S_VERSION=$(K8S_VERSION) ./hack/kind-e2e.sh
+
+# Validate the kind-tier harness itself without a cluster (in-repo
+# apiserver, tight polling) — also runs as part of 'make test'
+.PHONY: e2e-kind-smoke
+e2e-kind-smoke:
+	E2E_KIND=smoke $(PYTHON) -m pytest tests/test_kind_e2e.py -q
+
 .PHONY: bench
 bench:
 	$(PYTHON) bench.py

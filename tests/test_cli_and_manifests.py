@@ -230,6 +230,12 @@ class TestHelmChart:
         assert values["controller"]["queueQps"] == 10
         # disabled by default so the chart installs without cert-manager
         assert values["webhook"]["enabled"] is False
+        # the no-cert-manager path (hack/kind-e2e.sh HELM_STAGE) and
+        # the extra-env knob it uses must stay declared
+        assert values["webhook"]["certManager"]["enabled"] is True
+        assert values["webhook"]["existingCertSecret"] == ""
+        assert values["webhook"]["caBundle"] == ""
+        assert values["env"] == {}
         for name in ("deployment.yaml", "rbac.yaml", "webhook.yaml",
                      "serviceaccount.yaml", "_helpers.tpl", "NOTES.txt"):
             assert os.path.exists(os.path.join(self.CHART, "templates", name))

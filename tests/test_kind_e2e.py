@@ -8,15 +8,19 @@ The analog of the reference's kind tier (``e2e/e2e_test.go:78-98``,
 
 Modes (``E2E_KIND``):
 
-- ``1``     — a real cluster: ``hack/kind-e2e.sh`` creates a kind
-              cluster, generates webhook TLS material, and runs this
-              file with KUBECONFIG + E2E_WEBHOOK_* set.  Any genuine
-              apiserver works (k3s/minikube): point KUBECONFIG at it.
-- ``smoke`` — the in-repo test apiserver: validates this tier's OWN
-              harness logic (fixtures, polling, subprocess drive)
-              offline so it can't rot; protocol-proving tests that
-              need real apiserver features (apiextensions, admission
-              registration, TLS) skip themselves.  Runs in CI via
+- ``1``     — a real cluster: ``make e2e-kind`` (→ ``hack/kind-e2e.sh``)
+              creates a kind cluster, generates webhook TLS material,
+              and runs this file with KUBECONFIG + E2E_WEBHOOK_* set.
+              Any genuine apiserver works (k3s/minikube): point
+              KUBECONFIG at it.  CI: the ``kind`` job in
+              ``.github/workflows/e2e.yml`` (3-version k8s matrix).
+              Recorded runs + environment caveats: KIND_E2E_RESULTS.md.
+- ``smoke`` — the in-repo test apiserver (``make e2e-kind-smoke``):
+              validates this tier's OWN harness logic (fixtures,
+              polling, subprocess drive) offline so it can't rot;
+              protocol-proving tests that need real apiserver features
+              (apiextensions, admission registration, TLS) skip
+              themselves.  Runs inside ``make test`` via
               tests/test_kind_harness_smoke.py.
 - unset     — skipped entirely.
 

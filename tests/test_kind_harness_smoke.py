@@ -1,0 +1,56 @@
+"""CI guard for the opt-in real-apiserver (kind) tier: run
+``tests/test_kind_e2e.py`` in smoke mode — the in-repo test apiserver
+standing in for kind — in a subprocess so the tier's harness logic
+(fixtures, CRD/client wiring, subprocess controller drive, polling)
+can't rot between real-cluster runs.  The pattern mirror of
+``tests/test_real_aws_harness_smoke.py``; the real tier itself needs
+kind+docker (``hack/kind-e2e.sh``, reference
+``.github/workflows/e2e.yml:22-24``) and never runs here.
+
+Smoke mode's guaranteed floor: 3 protocol-shaped tests pass (typed
+CRUD/status/finalizers, informer list-watch-resync, full controller
+subprocess drive); the 3 that require genuine apiserver features
+(apiextensions Established, admission registration over TLS, node
+restart) skip with explicit reasons — they are the real tier's job.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_kind_harness_passes_in_smoke_mode():
+    env = dict(os.environ, E2E_KIND="smoke")
+    env.pop("KUBECONFIG", None)
+    env.pop("E2E_KIND_SOAK", None)
+    result = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_kind_e2e.py", "-q"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    # the floor is exact: a new smoke-capable test must pass, a new
+    # real-only test must carry its own skip reason
+    assert "3 passed" in result.stdout, result.stdout
+    assert "3 skipped" in result.stdout, result.stdout
+
+
+def test_kind_harness_skips_by_default():
+    env = dict(os.environ)
+    env.pop("E2E_KIND", None)
+    result = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_kind_e2e.py", "-q"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "6 skipped" in result.stdout, result.stdout
