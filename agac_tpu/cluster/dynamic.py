@@ -47,6 +47,8 @@ WELL_KNOWN_PLURALS: dict[tuple[str, str], str] = {
         "ValidatingWebhookConfiguration",
     ): "validatingwebhookconfigurations",
     ("operator.h3poteto.dev/v1alpha1", "EndpointGroupBinding"): "endpointgroupbindings",
+    # shipped by the Helm chart's webhook template (cert-manager path)
+    ("cert-manager.io/v1", "Certificate"): "certificates",
 }
 
 CLUSTER_SCOPED_KINDS = {
@@ -118,7 +120,8 @@ class DynamicClient:
         self, manifest: dict, field_manager: str = DEFAULT_FIELD_MANAGER
     ) -> dict:
         """Server-side apply; create-or-replace fallback on servers
-        without SSA support (405/415/400 from the PATCH verb)."""
+        without SSA support (405/415/501 from the PATCH verb — genuine
+        SSA rejections like 400/403/409/422 propagate)."""
         path = (
             f"{self._object_path(manifest)}"
             f"?fieldManager={field_manager}&force=true"

@@ -132,6 +132,9 @@ class RestClusterClient(ClusterClient):
         if body is not None:
             headers["Content-Type"] = "application/json"
             data = json.dumps(body).encode()
+        return self._send_with_auth_retry(method, url, headers, data, timeout, stream)
+
+    def _send_with_auth_retry(self, method, url, headers, data, timeout, stream):
         status, payload = self._transport(method, url, headers, data, timeout, stream)
         if status == 401 and self._token_provider is not None:
             # the server rejected the cached credential (early
@@ -163,7 +166,10 @@ class RestClusterClient(ClusterClient):
         """Untyped request sharing this client's base URL, TLS and
         credentials — the escape hatch the dynamic client
         (``cluster/dynamic.py``) builds on for kinds outside
-        ``KIND_REGISTRY``.  Returns ``(status, body)`` without raising."""
+        ``KIND_REGISTRY``.  Returns ``(status, body)`` without raising.
+        Shares ``request()``'s 401 invalidate-and-retry path so a
+        rotated service-account token refreshes instead of surfacing
+        as a hard error in long e2e runs."""
         url = f"{self.base_url}/{path.lstrip('/')}"
         headers = {"Accept": "application/json"}
         token = self._token_provider() if self._token_provider else self._token
@@ -171,7 +177,7 @@ class RestClusterClient(ClusterClient):
             headers["Authorization"] = f"Bearer {token}"
         if body is not None:
             headers["Content-Type"] = content_type
-        return self._transport(method, url, headers, body, timeout, False)
+        return self._send_with_auth_retry(method, url, headers, body, timeout, False)
 
     # ------------------------------------------------------------------
     # paths and serde

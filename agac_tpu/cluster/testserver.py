@@ -15,6 +15,7 @@ control plane.  Endpoints implemented (for every kind in
 - ``POST   /{prefix}/namespaces/{ns}/{plural}``       create
 - ``PUT    .../{name}``                               update
 - ``PUT    .../{name}/status``                        status subresource
+- ``PATCH  .../{name}`` (``application/apply-patch+yaml``) server-side apply
 - ``DELETE .../{name}``                               delete (finalizer-aware)
 
 Errors are k8s ``Status`` JSON with the proper HTTP codes so the REST
@@ -46,6 +47,20 @@ _PATH_TO_KIND = {
     (prefix, plural): kind
     for kind, (prefix, plural, _, _) in KIND_REGISTRY.items()
 }
+
+
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    """Recursive map merge for the apply route: nested dicts merge
+    key-by-key, everything else (scalars, lists) is replaced by the
+    overlay — the approximation of SSA the fallback-equivalence tests
+    rely on."""
+    merged = dict(base)
+    for key, value in overlay.items():
+        if isinstance(value, dict) and isinstance(merged.get(key), dict):
+            merged[key] = _deep_merge(merged[key], value)
+        else:
+            merged[key] = value
+    return merged
 
 
 def _full_wire(kind: str, obj) -> dict:
@@ -360,6 +375,129 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_obj(200, route.kind, updated)
 
+    def do_PATCH(self):
+        """Server-side apply (``application/apply-patch+yaml``), the
+        route ``DynamicClient.apply`` hits first — create-or-merge with
+        the fieldManager recorded in ``server.apply_managers`` so tests
+        can assert WHICH branch ran (reference analog: SSA through the
+        dynamic client, ``e2e/pkg/util/manifests.go:83-141``).
+
+        ``TestApiServer(ssa=False)`` answers 501 instead, standing in
+        for pre-SSA servers so the client's create-or-replace fallback
+        stays testable."""
+        parsed = urllib.parse.urlsplit(self.path)
+        route = _parse_path(parsed.path)
+        if route is None or not route.name:
+            self._send(404, _status_body(404, "NotFound", "unknown path"))
+            return
+        if route.subresource:
+            # the real apiserver supports apply on /status; this server
+            # does not emulate field ownership per subresource — be
+            # LOUD (400 propagates through DynamicClient, no fallback)
+            # rather than silently applying to the whole object
+            self._send(
+                400,
+                _status_body(
+                    400,
+                    "BadRequest",
+                    f"apply to subresource {route.subresource!r} is not "
+                    "implemented by the test apiserver",
+                ),
+            )
+            return
+        if not getattr(self.server, "ssa_enabled", True):
+            self._send(
+                501, _status_body(501, "NotImplemented", "SSA disabled")
+            )
+            return
+        content_type = (self.headers.get("Content-Type") or "").split(";")[0]
+        if content_type != "application/apply-patch+yaml":
+            # merge/json/strategic patch are not implemented here —
+            # 415 is what a server without the route family answers
+            self._send(
+                415,
+                _status_body(
+                    415, "UnsupportedMediaType", f"unsupported patch {content_type}"
+                ),
+            )
+            return
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        field_manager = query.get("fieldManager", "")
+        if not field_manager:
+            # the real apiserver rejects apply without a manager; NOT
+            # a fallback trigger (400 must propagate to the client)
+            self._send(
+                400,
+                _status_body(400, "BadRequest", "fieldManager is required for apply"),
+            )
+            return
+        import yaml as _yaml_mod
+
+        length = int(self.headers.get("Content-Length") or 0)
+        try:
+            manifest = _yaml_mod.safe_load(self.rfile.read(length)) or {}
+        except _yaml_mod.YAMLError as err:
+            self._send(400, _status_body(400, "BadRequest", f"bad YAML: {err}"))
+            return
+        metadata = (manifest.get("metadata") or {}) if isinstance(manifest, dict) else {}
+        body_name = metadata.get("name")
+        body_namespace = metadata.get("namespace")
+        if (body_name and body_name != route.name) or (
+            body_namespace and route.namespace and body_namespace != route.namespace
+        ):
+            # the real apiserver 400s on URL/body identity mismatch;
+            # silently creating the BODY's name would let smoke-mode
+            # tests pass that fail on kind
+            self._send(
+                400,
+                _status_body(
+                    400,
+                    "BadRequest",
+                    f"manifest identity {body_namespace}/{body_name} does not "
+                    f"match request path {route.namespace}/{route.name}",
+                ),
+            )
+            return
+        _, _, cls, _ = KIND_REGISTRY[route.kind]
+        try:
+            current = None
+            try:
+                current = self.cluster.get(route.kind, route.namespace, route.name)
+            except NotFoundError:
+                pass
+            if current is None:
+                obj = from_wire(cls, manifest)
+                denial = self._admit(route.kind, "CREATE", obj, None)
+                if denial is not None:
+                    self._send(403, _status_body(403, "Forbidden", denial))
+                    return
+                result = self.cluster.create(route.kind, obj)
+                code = 201
+            else:
+                # force=true apply over the live object: deep-merge the
+                # manifest's fields (maps merge, scalars/lists replace —
+                # full managed-fields ownership tracking is beyond this
+                # server's charter), on the CURRENT resourceVersion so
+                # the update never conflicts
+                merged = _deep_merge(_full_wire(route.kind, current), manifest)
+                merged.setdefault("metadata", {})["resourceVersion"] = (
+                    to_wire(current).get("metadata", {}).get("resourceVersion")
+                )
+                obj = from_wire(cls, merged)
+                denial = self._admit(route.kind, "UPDATE", obj, current)
+                if denial is not None:
+                    self._send(403, _status_body(403, "Forbidden", denial))
+                    return
+                result = self.cluster.update(route.kind, obj)
+                code = 200
+        except Exception as err:
+            self._send_error_status(err, f"{route.kind} {route.name}")
+            return
+        self.server.apply_managers[  # type: ignore[attr-defined]
+            (route.kind, route.namespace, route.name)
+        ] = field_manager
+        self._send_obj(code, route.kind, result)
+
     def do_DELETE(self):
         route = _parse_path(urllib.parse.urlsplit(self.path).path)
         if route is None or not route.name:
@@ -380,11 +518,20 @@ class TestApiServer:
 
     __test__ = False  # not a pytest collection target
 
-    def __init__(self, cluster: FakeCluster | None = None, port: int = 0):
+    def __init__(
+        self, cluster: FakeCluster | None = None, port: int = 0, ssa: bool = True
+    ):
         self.cluster = cluster or FakeCluster()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.cluster = self.cluster  # type: ignore[attr-defined]
         self._httpd.webhooks = {}  # type: ignore[attr-defined]
+        # SSA apply support (do_PATCH); ssa=False answers 501 so the
+        # DynamicClient's create-or-replace fallback can be exercised
+        self._httpd.ssa_enabled = ssa  # type: ignore[attr-defined]
+        # (kind, namespace, name) -> last apply fieldManager; only the
+        # SSA route writes this, so tests can prove which branch ran
+        self.apply_managers: dict[tuple[str, str, str], str] = {}
+        self._httpd.apply_managers = self.apply_managers  # type: ignore[attr-defined]
         # pagination snapshots: initialized once here (not lazily per
         # request — the threaded server would race and drop one) and
         # keyed by a monotonic counter, never id(), which CPython can

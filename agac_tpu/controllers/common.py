@@ -122,8 +122,13 @@ def run_workers(
 # ---------------------------------------------------------------------------
 
 # after this many consecutive reconcile FAILURES of the same item,
-# start warning: with the default 5 ms base / factor-2 backoff the
-# item has been failing for ~5 s and is clearly not transient
+# start warning.  Calibration, against the PRODUCTION per-item backoff
+# (controller_rate_limiter's ItemExponentialFailureRateLimiter: 5 ms
+# base, factor 2 — the client-go default shape): the waits between
+# failures 1..10 sum to 5 ms x (2^9 - 1) ~= 2.6 s, so the 10th failure
+# means ~3 s of wall clock plus nine failed reconcile attempts —
+# clearly not transient.  Tests tune the queue faster/slower; this
+# constant is deliberately NOT derived from any queue config.
 SYNC_WARNING_RETRY_THRESHOLD = 10
 
 # failures further apart than this are not "the same incident": the

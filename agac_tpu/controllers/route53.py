@@ -91,6 +91,12 @@ class Route53Controller:
             on_delete=self._delete_ingress_notification,
         )
         self._informer_factory = informer_factory
+        # "resource/ns/name" keys whose owned records a cleanup already
+        # removed: a PERSISTENTLY absent/blank hostname annotation must
+        # not rescan every hosted zone on each re-enqueue (r2 advisor).
+        # Plain set, no lock: add/discard/contains are atomic under the
+        # GIL and the worst race costs one redundant scan.
+        self._cleaned_up: set[str] = set()
 
     # ------------------------------------------------------------------
     # event handlers (reference ``route53/controller.go:89-170``)
@@ -199,6 +205,8 @@ class Route53Controller:
         ns, name = split_meta_namespace_key(key)
         cloud = self._cloud(GLOBAL_REGION)
         cloud.cleanup_record_set(self.cluster_name, resource, ns, name)
+        # the object is gone: a future namesake must get a fresh scan
+        self._cleaned_up.discard(f"{resource}/{ns}/{name}")
         return Result()
 
     def process_service_create_or_update(self, svc) -> Result:
@@ -219,10 +227,16 @@ class Route53Controller:
 
     def _process_create_or_update(self, obj, resource: str, lb_ingresses, kind: str) -> Result:
         ns, name = obj.metadata.namespace, obj.metadata.name
+        cleanup_key = f"{resource}/{ns}/{name}"
         hostname_annotation = obj.metadata.annotations.get(apis.ROUTE53_HOSTNAME_ANNOTATION)
         if hostname_annotation is None:
+            if cleanup_key in self._cleaned_up:
+                # already cleaned for this persistent no-annotation
+                # state — don't rescan all zones on every re-enqueue
+                return Result()
             cloud = self._cloud(GLOBAL_REGION)
             cloud.cleanup_record_set(self.cluster_name, resource, ns, name)
+            self._cleaned_up.add(cleanup_key)
             klog.infof("Delete route53 records for %s %s/%s", kind, ns, name)
             self.recorder.event(
                 obj, "Normal", "Route53RecordDeleted", "Route53 record sets are deleted"
@@ -238,8 +252,11 @@ class Route53Controller:
         # shares the flaw; the bar is beat).
         hostnames = [h.strip() for h in hostname_annotation.split(",") if h.strip()]
         if not hostnames:
+            if cleanup_key in self._cleaned_up:
+                return Result()
             cloud = self._cloud(GLOBAL_REGION)
             cloud.cleanup_record_set(self.cluster_name, resource, ns, name)
+            self._cleaned_up.add(cleanup_key)
             self.recorder.eventf(
                 obj, "Warning", "InvalidAnnotation",
                 "annotation %s is empty: expected comma-separated hostnames; "
@@ -247,6 +264,9 @@ class Route53Controller:
                 apis.ROUTE53_HOSTNAME_ANNOTATION,
             )
             return Result()
+        # records are being (re)created: the next blanking/removal must
+        # clean up again
+        self._cleaned_up.discard(cleanup_key)
         for lb_ingress in lb_ingresses:
             try:
                 provider = detect_cloud_provider(lb_ingress.hostname)

@@ -179,6 +179,60 @@ class TestSyncFailureSurfacing:
         )
         assert wait_until(lambda: harness.aws.records_in_zone(zone.id) == [])
 
+    def test_blank_annotation_cleanup_runs_once_not_per_enqueue(self):
+        """A persistently blank/absent hostname annotation must not
+        rescan every hosted zone on each re-enqueue (r2 advisor):
+        cleanup runs once per blanking, and again only after the
+        annotation was non-empty in between or the object is deleted."""
+        from agac_tpu.cluster import SharedInformerFactory
+        from agac_tpu.controllers.route53 import Route53Config, Route53Controller
+
+        class CountingCloud:
+            def __init__(self):
+                self.cleanups = 0
+
+            def cleanup_record_set(self, cluster_name, resource, ns, name):
+                self.cleanups += 1
+
+            def ensure_route53_for_service(self, obj, lb, hostnames, cluster):
+                return False, 0
+
+        cloud = CountingCloud()
+        cluster = FakeCluster()
+        controller = Route53Controller(
+            cluster,
+            SharedInformerFactory(cluster, resync_period=30.0),
+            Route53Config(),
+            cloud_factory=lambda region: cloud,
+        )
+
+        svc = make_lb_service(
+            annotations={apis.ROUTE53_HOSTNAME_ANNOTATION: "  "}
+        )
+        for _ in range(3):  # resyncs / status updates re-enqueue
+            controller.process_service_create_or_update(svc)
+        assert cloud.cleanups == 1
+
+        # annotation removed entirely: same persistent state, no rescan
+        del svc.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION]
+        controller.process_service_create_or_update(svc)
+        assert cloud.cleanups == 1
+
+        # records recreated, then blanked again → one fresh cleanup
+        svc.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = "a.example.com"
+        controller.process_service_create_or_update(svc)
+        svc.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = ""
+        for _ in range(2):
+            controller.process_service_create_or_update(svc)
+        assert cloud.cleanups == 2
+
+        # delete always cleans and forgets the key (a recreated
+        # namesake must get a fresh scan)
+        controller.process_service_delete("default/web")
+        assert cloud.cleanups == 3
+        controller.process_service_create_or_update(svc)
+        assert cloud.cleanups == 4
+
     def test_unparseable_lb_hostname_warns(self, harness):
         # aws suffix (passes detect_cloud_provider) but no ELB shape
         svc = make_lb_service(hostname="mystery.us-west-2.amazonaws.com")

@@ -498,6 +498,61 @@ class TestWebhookThroughRealApiserver:
 
 
 class TestApiserverRestartSoak:
+    def test_informer_survives_embedded_apiserver_restart(self):
+        """Time-compressed smoke rendition of the kind soak below
+        (VERDICT r2 next#6): stop the embedded apiserver mid-watch,
+        bring it back on the same port with the same backing state
+        (etcd's role), and assert the informer relists with no drift —
+        so the soak's assertion logic itself is CI-covered, not just
+        written.  Self-contained: runs in both tier modes."""
+        from agac_tpu.cluster.informer import SharedInformerFactory
+        from agac_tpu.cluster.rest import RestClusterClient
+        from agac_tpu.cluster.testserver import TestApiServer
+
+        from .fixtures import make_lb_service
+
+        prefix = "smoke-soak"
+        first = TestApiServer().start()
+        port = int(first.url.rsplit(":", 1)[1])
+        local_client = RestClusterClient(first.url)
+        stop = threading.Event()
+        factory = SharedInformerFactory(local_client, resync_period=0.5)
+        informer = factory.informer("Service")
+        factory.start(stop)
+        second = None
+        try:
+            assert factory.wait_for_cache_sync(stop)
+            local_client.create("Service", make_lb_service(name=f"{prefix}-pre"))
+            lister = informer.lister()
+            assert wait_until(
+                lambda: any(
+                    s.metadata.name == f"{prefix}-pre" for s in lister.list()
+                )
+            )
+
+            first.stop()  # mid-watch outage: streams die, writes fail
+            with pytest.raises(Exception):
+                local_client.create(
+                    "Service", make_lb_service(name=f"{prefix}-down")
+                )
+            # same state, same address — the kubelet-restarts-the-
+            # static-pod moment
+            second = TestApiServer(cluster=first.cluster, port=port).start()
+            local_client.create("Service", make_lb_service(name=f"{prefix}-post"))
+            assert wait_until(
+                lambda: {
+                    s.metadata.name
+                    for s in lister.list()
+                    if s.metadata.name.startswith(prefix)
+                }
+                == {f"{prefix}-pre", f"{prefix}-post"},
+                timeout=15,
+            ), "informer cache drifted after embedded apiserver restart"
+        finally:
+            stop.set()
+            if second is not None:
+                second.stop()
+
     def test_informer_survives_apiserver_restart(self, client, crd):
         """Kill kube-apiserver inside the kind node (kubelet restarts
         the static pod); the informer must relist and show no drift

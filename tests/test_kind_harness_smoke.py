@@ -7,19 +7,30 @@ can't rot between real-cluster runs.  The pattern mirror of
 kind+docker (``hack/kind-e2e.sh``, reference
 ``.github/workflows/e2e.yml:22-24``) and never runs here.
 
-Smoke mode's guaranteed floor: 3 protocol-shaped tests pass (typed
+Smoke mode's guaranteed floor: 4 protocol-shaped tests pass (typed
 CRUD/status/finalizers, informer list-watch-resync, full controller
-subprocess drive); the 3 that require genuine apiserver features
-(apiextensions Established, admission registration over TLS, node
-restart) skip with explicit reasons — they are the real tier's job.
+subprocess drive, embedded-apiserver restart soak); the 3 that require
+genuine apiserver features (apiextensions Established, admission
+registration over TLS, node restart) skip with explicit reasons —
+they are the real tier's job.
 """
 
 import os
 import pathlib
+import re
 import subprocess
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _counts(stdout: str) -> dict:
+    """Exact {outcome: N} from the pytest summary line — substring
+    checks would let '14 passed' satisfy a '4 passed' floor."""
+    return {
+        outcome: int(n)
+        for n, outcome in re.findall(r"(\d+) (passed|failed|skipped|error)", stdout)
+    }
 
 
 def test_kind_harness_passes_in_smoke_mode():
@@ -37,8 +48,7 @@ def test_kind_harness_passes_in_smoke_mode():
     assert result.returncode == 0, result.stdout + result.stderr
     # the floor is exact: a new smoke-capable test must pass, a new
     # real-only test must carry its own skip reason
-    assert "3 passed" in result.stdout, result.stdout
-    assert "3 skipped" in result.stdout, result.stdout
+    assert _counts(result.stdout) == {"passed": 4, "skipped": 3}, result.stdout
 
 
 def test_kind_harness_skips_by_default():
@@ -53,4 +63,4 @@ def test_kind_harness_skips_by_default():
         timeout=120,
     )
     assert result.returncode == 0, result.stdout + result.stderr
-    assert "6 skipped" in result.stdout, result.stdout
+    assert _counts(result.stdout) == {"skipped": 7}, result.stdout

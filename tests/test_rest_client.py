@@ -523,6 +523,36 @@ class TestExecCredentials:
         assert len(stub.requests) == 2
         assert stub.requests[1][2]["Authorization"].startswith("Bearer ")
 
+    def test_raw_request_shares_401_invalidate_and_retry(self, stub):
+        """The dynamic client's transport (raw_request) must refresh a
+        rotated token the same way request() does, or long kind e2e
+        runs die on the first SA-token rotation (r2 advisor finding)."""
+        from agac_tpu.cluster.rest import RestClusterClient
+
+        class Rotating:
+            def __init__(self):
+                self.token = "stale-token"
+                self.invalidated = 0
+
+            def __call__(self):
+                return self.token
+
+            def invalidate(self):
+                self.invalidated += 1
+                self.token = "fresh-token"
+
+        provider = Rotating()
+        client = RestClusterClient("http://api:8080", token_provider=provider)
+        client._transport = stub
+        stub.queue(401, {"message": "token expired"})
+        stub.queue(200, {"metadata": {"name": "web"}})
+        status, _ = client.raw_request("GET", "api/v1/namespaces/default/services/web")
+        assert status == 200
+        assert provider.invalidated == 1
+        assert len(stub.requests) == 2
+        assert stub.requests[0][2]["Authorization"] == "Bearer stale-token"
+        assert stub.requests[1][2]["Authorization"] == "Bearer fresh-token"
+
     def test_401_with_empty_refresh_drops_rejected_header(self, stub):
         """If the forced refresh yields no token, the retry must not
         resend the Authorization header the server just rejected."""
