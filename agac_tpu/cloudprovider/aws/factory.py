@@ -40,7 +40,12 @@ def _discovery_cache_ttl() -> float:
     global _discovery_ttl
     if _discovery_ttl is not None:
         return _discovery_ttl
-    raw = os.environ.get("AGAC_DISCOVERY_CACHE_TTL", "5")
+    # 30 s default: the write journal (cache.py) makes the TTL a pure
+    # cross-process staleness bound — local writes are always visible —
+    # so it can match the 30 s informer-resync staleness the reference
+    # already tolerates; measured at N=1000 this cuts refresh scans 6x
+    # vs the old 5 s with no correctness cost
+    raw = os.environ.get("AGAC_DISCOVERY_CACHE_TTL", "30")
     try:
         ttl = float(raw)
     except ValueError:
@@ -50,9 +55,9 @@ def _discovery_cache_ttl() -> float:
         from ... import klog
 
         klog.errorf(
-            "AGAC_DISCOVERY_CACHE_TTL=%r is not a number; using default 5s", raw
+            "AGAC_DISCOVERY_CACHE_TTL=%r is not a number; using default 30s", raw
         )
-        ttl = 5.0
+        ttl = 30.0
     _discovery_ttl = ttl
     return ttl
 

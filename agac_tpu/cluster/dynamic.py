@@ -117,14 +117,23 @@ class DynamicClient:
         return json.loads(body)
 
     def apply(
-        self, manifest: dict, field_manager: str = DEFAULT_FIELD_MANAGER
+        self,
+        manifest: dict,
+        field_manager: str = DEFAULT_FIELD_MANAGER,
+        force: bool = True,
     ) -> dict:
         """Server-side apply; create-or-replace fallback on servers
         without SSA support (405/415/501 from the PATCH verb — genuine
-        SSA rejections like 400/403/409/422 propagate)."""
+        SSA rejections like 400/403/409/422 propagate).
+
+        ``force=True`` (the default, matching the reference's
+        ``Force: true``, ``e2e/pkg/util/manifests.go:120-141``) takes
+        ownership of fields held by other field managers; with
+        ``force=False`` an overlapping apply surfaces the server's
+        409 Conflict as ``DynamicApplyError``."""
         path = (
             f"{self._object_path(manifest)}"
-            f"?fieldManager={field_manager}&force=true"
+            f"?fieldManager={field_manager}&force={'true' if force else 'false'}"
         )
         status, body = self._rest.raw_request(
             "PATCH",

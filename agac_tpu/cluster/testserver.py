@@ -63,6 +63,30 @@ def _deep_merge(base: dict, overlay: dict) -> dict:
     return merged
 
 
+# identity fields every applier co-owns without conflict (the real
+# apiserver's managedFields never attribute these to one manager)
+_APPLY_IDENTITY_FIELDS = frozenset(
+    {"apiVersion", "kind", "metadata.name", "metadata.namespace",
+     "metadata.resourceVersion"}
+)
+
+
+def _apply_leaf_paths(manifest: dict, prefix: tuple = ()) -> list[str]:
+    """Dot-joined leaf field paths an apply of ``manifest`` claims:
+    maps recurse, scalars/lists/empty-maps are leaves (the granularity
+    real SSA tracks atomic fields at — list-item-level ownership is
+    beyond this server's charter).  Identity fields are excluded."""
+    paths = []
+    if isinstance(manifest, dict) and manifest:
+        for key, value in manifest.items():
+            paths.extend(_apply_leaf_paths(value, prefix + (str(key),)))
+    else:
+        path = ".".join(prefix)
+        if path and path not in _APPLY_IDENTITY_FIELDS:
+            paths.append(path)
+    return paths
+
+
 def _full_wire(kind: str, obj) -> dict:
     """Wire envelope: serde dict stamped with apiVersion + kind."""
     _, _, _, api_version = KIND_REGISTRY[kind]
@@ -380,7 +404,11 @@ class _Handler(BaseHTTPRequestHandler):
         route ``DynamicClient.apply`` hits first — create-or-merge with
         the fieldManager recorded in ``server.apply_managers`` so tests
         can assert WHICH branch ran (reference analog: SSA through the
-        dynamic client, ``e2e/pkg/util/manifests.go:83-141``).
+        dynamic client, ``e2e/pkg/util/manifests.go:83-141``).  Field
+        ownership is tracked per leaf path in ``server.field_owners``:
+        a second manager applying an owned field gets 409 Conflict
+        unless ``force=true`` takes the field over — so the client's
+        force contract is asserted against a server that can say no.
 
         ``TestApiServer(ssa=False)`` answers 501 instead, standing in
         for pre-SSA servers so the client's create-or-replace fallback
@@ -423,6 +451,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         query = dict(urllib.parse.parse_qsl(parsed.query))
         field_manager = query.get("fieldManager", "")
+        force = query.get("force", "false") == "true"
         if not field_manager:
             # the real apiserver rejects apply without a manager; NOT
             # a fallback trigger (400 must propagate to the client)
@@ -459,12 +488,57 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         _, _, cls, _ = KIND_REGISTRY[route.kind]
+        owner_key = (route.kind, route.namespace, route.name)
+        claimed = _apply_leaf_paths(manifest)
+        # the whole read-adjudicate-write sequence must be atomic under
+        # ThreadingHTTPServer: without this, two concurrent non-force
+        # applies from different managers both read a not-yet-written
+        # owners map, both pass the conflict gate, and the last writer
+        # silently takes fields the real apiserver would 409
+        with self.server.apply_lock:  # type: ignore[attr-defined]
+            self._apply_locked(route, cls, owner_key, claimed, manifest,
+                               field_manager, force)
+
+    def _apply_locked(
+        self, route, cls, owner_key, claimed, manifest, field_manager, force
+    ):
         try:
             current = None
             try:
                 current = self.cluster.get(route.kind, route.namespace, route.name)
             except NotFoundError:
                 pass
+            if current is not None:
+                # field-manager conflict semantics (the contract
+                # ``DynamicClient.apply(force=...)`` is written
+                # against, reference ``e2e/pkg/util/manifests.go:
+                # 120-141`` Force: true): a field owned by a DIFFERENT
+                # manager conflicts — 409 without force, ownership
+                # takeover with it.  Value equality does not matter:
+                # real SSA conflicts between appliers regardless of
+                # the value being applied.
+                owners = self.server.field_owners.get(owner_key, {})  # type: ignore[attr-defined]
+                conflicts = sorted(
+                    (path, owners[path])
+                    for path in claimed
+                    if owners.get(path) not in (None, field_manager)
+                )
+                if conflicts and not force:
+                    detail = ", ".join(
+                        f'conflict with "{manager}": .{path}'
+                        for path, manager in conflicts
+                    )
+                    plural = "s" if len(conflicts) != 1 else ""
+                    self._send(
+                        409,
+                        _status_body(
+                            409,
+                            "Conflict",
+                            f"Apply failed with {len(conflicts)} "
+                            f"conflict{plural}: {detail}",
+                        ),
+                    )
+                    return
             if current is None:
                 obj = from_wire(cls, manifest)
                 denial = self._admit(route.kind, "CREATE", obj, None)
@@ -474,11 +548,11 @@ class _Handler(BaseHTTPRequestHandler):
                 result = self.cluster.create(route.kind, obj)
                 code = 201
             else:
-                # force=true apply over the live object: deep-merge the
-                # manifest's fields (maps merge, scalars/lists replace —
-                # full managed-fields ownership tracking is beyond this
-                # server's charter), on the CURRENT resourceVersion so
-                # the update never conflicts
+                # apply over the live object (conflicts already
+                # adjudicated above): deep-merge the manifest's fields
+                # (maps merge, scalars/lists replace), on the CURRENT
+                # resourceVersion so the storage update itself never
+                # optimistic-locks
                 merged = _deep_merge(_full_wire(route.kind, current), manifest)
                 merged.setdefault("metadata", {})["resourceVersion"] = (
                     to_wire(current).get("metadata", {}).get("resourceVersion")
@@ -496,6 +570,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.server.apply_managers[  # type: ignore[attr-defined]
             (route.kind, route.namespace, route.name)
         ] = field_manager
+        # the applier now owns every field it claimed (including any
+        # it took over with force)
+        owned = self.server.field_owners.setdefault(owner_key, {})  # type: ignore[attr-defined]
+        for path in claimed:
+            owned[path] = field_manager
         self._send_obj(code, route.kind, result)
 
     def do_DELETE(self):
@@ -508,6 +587,15 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as err:
             self._send_error_status(err, f"{route.kind} {route.name}")
             return
+        # a deleted object's field ownership dies with it: a future
+        # namesake starts with a clean managedFields slate
+        with self.server.apply_lock:  # type: ignore[attr-defined]
+            self.server.field_owners.pop(  # type: ignore[attr-defined]
+                (route.kind, route.namespace, route.name), None
+            )
+            self.server.apply_managers.pop(  # type: ignore[attr-defined]
+                (route.kind, route.namespace, route.name), None
+            )
         self._send(200, _status_body(200, "Success", "deleted").replace(b"Failure", b"Success"))
 
 
@@ -532,6 +620,15 @@ class TestApiServer:
         # SSA route writes this, so tests can prove which branch ran
         self.apply_managers: dict[tuple[str, str, str], str] = {}
         self._httpd.apply_managers = self.apply_managers  # type: ignore[attr-defined]
+        # (kind, namespace, name) -> {leaf field path -> fieldManager}:
+        # enough managed-fields bookkeeping to say NO — overlapping
+        # apply from a second manager is 409 without force, takeover
+        # with it (the real apiserver's apply conflict contract)
+        self.field_owners: dict[tuple[str, str, str], dict[str, str]] = {}
+        self._httpd.field_owners = self.field_owners  # type: ignore[attr-defined]
+        # serializes apply conflict adjudication (read owners → admit →
+        # write → record owners) across handler threads
+        self._httpd.apply_lock = threading.Lock()  # type: ignore[attr-defined]
         # pagination snapshots: initialized once here (not lazily per
         # request — the threaded server would race and drop one) and
         # keyed by a monotonic counter, never id(), which CPython can

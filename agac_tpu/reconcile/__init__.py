@@ -14,9 +14,15 @@ from .workqueue import (
     controller_rate_limiter,
     default_controller_rate_limiter,
 )
-from .reconcile import process_next_work_item
+from .reconcile import (
+    add_sync_duration_observer,
+    process_next_work_item,
+    remove_sync_duration_observer,
+)
 
 __all__ = [
+    "add_sync_duration_observer",
+    "remove_sync_duration_observer",
     "Result",
     "RateLimitingQueue",
     "ItemExponentialFailureRateLimiter",

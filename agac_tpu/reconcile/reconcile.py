@@ -35,6 +35,38 @@ from .workqueue import RateLimitingQueue
 KeyToObjFunc = Callable[[str], Any]
 ProcessDeleteFunc = Callable[[str], Result]
 ProcessCreateOrUpdateFunc = Callable[[Any], Result]
+
+# ---------------------------------------------------------------------------
+# sync-duration observers — a process-global metrics seam (the analog of
+# controller-runtime's global metrics registry; the reference only LOGS
+# the per-item duration via its v4 defer, ``reconcile.go:44-47``).
+# Observers receive (key, seconds, error_or_None) after every completed
+# sync pass, on the worker thread; ``threading.current_thread().name``
+# carries the controller name (``run_workers`` names its threads
+# ``{controller}-worker-{i}``) for per-controller breakdowns.  Observer
+# exceptions are contained like hook exceptions.
+# ---------------------------------------------------------------------------
+SyncDurationObserver = Callable[[str, float, "Exception | None"], None]
+_sync_duration_observers: list[SyncDurationObserver] = []
+
+
+def add_sync_duration_observer(fn: SyncDurationObserver) -> None:
+    _sync_duration_observers.append(fn)
+
+
+def remove_sync_duration_observer(fn: SyncDurationObserver) -> None:
+    try:
+        _sync_duration_observers.remove(fn)
+    except ValueError:
+        pass
+
+
+def _observe_sync_duration(key: str, seconds: float, err: "Exception | None") -> None:
+    for fn in list(_sync_duration_observers):
+        try:
+            fn(key, seconds, err)
+        except Exception as obs_err:
+            klog.errorf("sync duration observer failed for %r: %s", key, obs_err)
 # (key, error_or_None, num_requeues, permanent) — observability hook
 # fired after the retry policy has been applied.  ``error`` is None on
 # a successful sync (so streak-tracking hooks can reset); ``permanent``
@@ -92,7 +124,10 @@ def _reconcile_handler(
     try:
         res, err = _dispatch(key, key_to_obj, process_delete, process_create_or_update)
     finally:
-        klog.v(4).infof("Finished syncing %r (%.3fs)", key, time.monotonic() - start)
+        elapsed = time.monotonic() - start
+        klog.v(4).infof("Finished syncing %r (%.3fs)", key, elapsed)
+    if _sync_duration_observers:
+        _observe_sync_duration(key, elapsed, err)
 
     if err is not None:
         permanent = is_no_retry(err)

@@ -1,26 +1,56 @@
-"""End-to-end reconcile-throughput benchmark.
+"""End-to-end reconcile-throughput benchmark under a realistic AWS model.
 
 The reference publishes no benchmark numbers (BASELINE.md: no
 ``benchmarks/`` dir, no ``Benchmark*`` funcs, no perf claims), so this
 measures the framework's own headline capability — full watch →
-informer → queue → reconcile → cloud-ensure convergence — and reports
-``vs_baseline`` against the reference's implicit operating point: its
-default configuration processes items with 1 worker per queue
-(``cmd/controller/controller.go:32``) and is bounded by serial AWS
-round trips per reconcile (the N+1 ListTags scan,
-``global_accelerator.go:87-110``); with its in-code timings a single
-item converges in one reconcile pass, so the baseline proxy here is
-this framework run at the reference operating point (workers=1,
-client-go default 10 qps/100 burst enqueue bucket, no discovery
-cache) — vs_baseline = throughput(tuned) / throughput(reference point)
-shows the headroom the rebuild's knobs add on identical fake-cloud
-latency: concurrent workers, a tunable enqueue bucket
-(--queue-qps/--queue-burst), and the incremental discovery cache.
+informer → queue → reconcile → cloud-ensure convergence of the
+GlobalAccelerator AND Route53 controllers together — and reports
+``vs_baseline`` against the reference's implicit operating point
+(1 worker per queue, ``cmd/controller/controller.go:32``; client-go's
+fixed 10 qps / 100 burst enqueue bucket; the O(N)+1 ListTags discovery
+scan on every reconcile, ``global_accelerator.go:87-110``).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The fake cloud is SHAPED, not uniform:
+
+- **Asymmetric per-operation latency.**  Every operation of all three
+  API families (GlobalAccelerator, ELBv2, Route53 — endpoint-group and
+  record-change ops included) sleeps a per-op latency taken from
+  real-world control-plane behavior (CreateAccelerator is the slowest
+  by an order of magnitude; List*/Describe* are fast).  Latencies are
+  scaled to 1/10 of their real-world values so the bench completes in
+  minutes; quotas are scaled x10 to match, so the RELATIVE pressure
+  (which API binds, how much concurrency pays) is preserved under the
+  time compression.
+- **Per-API throttle quotas.**  Each API family has a token bucket
+  (GA mutate, GA read, ELBv2, Route53).  A call that finds the bucket
+  empty BLOCKS until admitted — modeling an SDK in standard-retry mode
+  pacing itself under ThrottlingException rather than surfacing the
+  throttle to the application (our production client does the same:
+  ``real_backend.py`` standard retry mode).  The Route53 quota is
+  AWS's documented 5 req/s (x10 scale).
+
+The workload drives every family: each Service carries both the
+GA-managed annotation and a ``route53-hostname`` annotation resolving
+into one of 10 hosted zones, so convergence requires N accelerator
+chains (accelerator + listener + endpoint group) AND 2N Route53
+records (atomic TXT+A pair per service).
+
+The baseline is measured at N_BASELINE=100 services because the
+reference operating point's O(N) tag-scan per reconcile makes serial
+convergence at N=1000 intractable (hours).  Comparing per-service
+rates FAVORS the baseline: its rate degrades superlinearly with N, so
+vs_baseline understates the gap at N=1000.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"detail"} where detail carries per-controller p50/p99 per-item
+reconcile latency (via the reconcile loop's sync-duration observer
+seam), the steady-state AWS-call rate measured over one full 30 s
+resync cycle after convergence, per-op AWS call counts, and the
+latency/quota model itself so movement is auditable.
 """
 
 import json
+import os
 import threading
 import time
 
@@ -29,47 +59,169 @@ from agac_tpu.cloudprovider.aws.cache import DiscoveryCache
 from agac_tpu.apis import (
     AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
     AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROUTE53_HOSTNAME_ANNOTATION,
 )
 from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
 from agac_tpu.cluster import FakeCluster, LoadBalancerIngress, ObjectMeta, Service, ServicePort
 from agac_tpu.cluster.objects import ServiceSpec
 from agac_tpu.manager import ControllerConfig, Manager
+from agac_tpu.reconcile import (
+    BucketRateLimiter,
+    add_sync_duration_observer,
+    remove_sync_duration_observer,
+)
 from agac_tpu.controllers import (
     EndpointGroupBindingConfig,
     GlobalAcceleratorConfig,
     Route53Config,
 )
 
-N_SERVICES = 150
-SIMULATED_AWS_LATENCY = 0.002  # 2 ms per AWS call, applied uniformly
+N_SERVICES = int(os.environ.get("AGAC_BENCH_N", "1000"))
+N_BASELINE = int(os.environ.get("AGAC_BENCH_N_BASELINE", "100"))
+N_ZONES = 10
+TUNED_WORKERS = int(os.environ.get("AGAC_BENCH_WORKERS", "32"))
+RESYNC_PERIOD = 30.0  # the reference's informer resync default
+STEADY_WINDOW = RESYNC_PERIOD  # one full resync cycle
+DEADLINE = 900.0
+
+# Time compression: real-world latencies / LATENCY_SCALE, quotas
+# x LATENCY_SCALE — same shape, 1/10 the wall clock.
+LATENCY_SCALE = 10.0
+
+# Real-world control-plane latencies (seconds) before scaling.
+# Create/Update/Delete on Global Accelerator are slow async control
+# operations; reads are fast; Route53 ChangeResourceRecordSets commits
+# a transaction.  Shape, not vendor-measured precision, is the point:
+# the slowest op is ~15x the fastest and mutates cost multiples of
+# reads, so concurrency and caching are rewarded the way they are
+# against the real control plane.
+REAL_LATENCY = {
+    # GlobalAccelerator mutating
+    "create_accelerator": 1.5,
+    "update_accelerator": 1.0,
+    "delete_accelerator": 1.0,
+    "create_listener": 0.5,
+    "update_listener": 0.5,
+    "delete_listener": 0.5,
+    "create_endpoint_group": 0.5,
+    "update_endpoint_group": 0.5,
+    "delete_endpoint_group": 0.5,
+    "add_endpoints": 0.3,
+    "remove_endpoints": 0.3,
+    "tag_resource": 0.2,
+    # GlobalAccelerator reads
+    "list_accelerators": 0.3,
+    "describe_accelerator": 0.2,
+    "list_tags_for_resource": 0.1,
+    "list_listeners": 0.15,
+    "list_endpoint_groups": 0.15,
+    "describe_endpoint_group": 0.15,
+    # ELBv2
+    "describe_load_balancers": 0.2,
+    # Route53
+    "list_hosted_zones": 0.2,
+    "list_hosted_zones_by_name": 0.2,
+    "list_resource_record_sets": 0.25,
+    "change_resource_record_sets": 0.5,
+}
+
+# API family -> (sustained requests/sec, burst) AFTER scaling.
+# Real-world: GA mutate ~5/s, GA read ~20/s, ELBv2 describe ~10/s,
+# Route53 5/s (the one AWS documents).
+QUOTAS = {
+    "ga_mutate": (50.0, 100),
+    "ga_read": (200.0, 400),
+    "elbv2": (100.0, 200),
+    "route53": (50.0, 100),
+}
+
+OP_FAMILY = {
+    "create_accelerator": "ga_mutate",
+    "update_accelerator": "ga_mutate",
+    "delete_accelerator": "ga_mutate",
+    "create_listener": "ga_mutate",
+    "update_listener": "ga_mutate",
+    "delete_listener": "ga_mutate",
+    "create_endpoint_group": "ga_mutate",
+    "update_endpoint_group": "ga_mutate",
+    "delete_endpoint_group": "ga_mutate",
+    "add_endpoints": "ga_mutate",
+    "remove_endpoints": "ga_mutate",
+    "tag_resource": "ga_mutate",
+    "list_accelerators": "ga_read",
+    "describe_accelerator": "ga_read",
+    "list_tags_for_resource": "ga_read",
+    "list_listeners": "ga_read",
+    "list_endpoint_groups": "ga_read",
+    "describe_endpoint_group": "ga_read",
+    "describe_load_balancers": "elbv2",
+    "list_hosted_zones": "route53",
+    "list_hosted_zones_by_name": "route53",
+    "list_resource_record_sets": "route53",
+    "change_resource_record_sets": "route53",
+}
 
 
-class LatencyAWS(FakeAWSBackend):
-    """Fake AWS with a uniform simulated per-call latency so the
-    benchmark exercises IO-bound concurrency, not pure Python speed."""
+class TokenBucket:
+    """Blocking facade over the framework's own ``BucketRateLimiter``
+    (one canonical token-bucket implementation): ``acquire`` reserves
+    a token and sleeps until its admission time — FIFO-fair under
+    contention, sustained rate exactly ``rate`` once the burst is
+    spent."""
+
+    def __init__(self, rate: float, burst: int):
+        self._limiter = BucketRateLimiter(qps=rate, burst=burst)
+        self._stat_lock = threading.Lock()
+        self.throttled_waits = 0  # acquisitions that had to wait
+
+    def acquire(self) -> None:
+        wait = self._limiter.when(None)
+        if wait > 0:
+            with self._stat_lock:
+                self.throttled_waits += 1
+            time.sleep(wait)
+
+
+class ShapedAWS(FakeAWSBackend):
+    """FakeAWSBackend with asymmetric per-op latency and per-API-family
+    blocking throttle quotas on EVERY operation, plus per-op counters
+    for call-rate accounting."""
+
+    _SHAPED = frozenset(REAL_LATENCY)
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.op_counts: dict[str, int] = {}
+        self._count_lock = threading.Lock()
+        self._buckets = {
+            family: TokenBucket(rate, burst) for family, (rate, burst) in QUOTAS.items()
+        }
+
+    def total_calls(self) -> int:
+        with self._count_lock:
+            return sum(self.op_counts.values())
 
     def __getattribute__(self, name):
         attr = super().__getattribute__(name)
-        if name in (
-            "list_accelerators",
-            "list_tags_for_resource",
-            "describe_load_balancers",
-            "create_accelerator",
-            "create_listener",
-            "create_endpoint_group",
-            "list_listeners",
-            "list_endpoint_groups",
-        ):
-            def timed(*args, **kwargs):
-                time.sleep(SIMULATED_AWS_LATENCY)
-                return attr(*args, **kwargs)
+        if name.startswith("_") or name not in ShapedAWS._SHAPED:
+            return attr
+        bucket = super().__getattribute__("_buckets")[OP_FAMILY[name]]
+        count_lock = super().__getattribute__("_count_lock")
+        op_counts = super().__getattribute__("op_counts")
+        latency = REAL_LATENCY[name] / LATENCY_SCALE
 
-            return timed
-        return attr
+        def shaped(*args, **kwargs):
+            with count_lock:
+                op_counts[name] = op_counts.get(name, 0) + 1
+            bucket.acquire()  # throttle admission (SDK-style pacing)
+            time.sleep(latency)  # server-side processing time
+            return attr(*args, **kwargs)
+
+        return shaped
 
 
 def make_service(i: int) -> Service:
-    hostname = f"bench{i:04d}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+    lb_host = f"bench{i:04d}-0123456789abcdef.elb.us-west-2.amazonaws.com"
     svc = Service(
         metadata=ObjectMeta(
             name=f"bench{i:04d}",
@@ -77,30 +229,69 @@ def make_service(i: int) -> Service:
             annotations={
                 AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
                 AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                ROUTE53_HOSTNAME_ANNOTATION: (
+                    f"bench{i:04d}.z{i % N_ZONES}.bench.example.com"
+                ),
             },
         ),
         spec=ServiceSpec(
             type="LoadBalancer", ports=[ServicePort(name="http", port=80, protocol="TCP")]
         ),
     )
-    svc.status.load_balancer.ingress.append(LoadBalancerIngress(hostname=hostname))
+    svc.status.load_balancer.ingress.append(LoadBalancerIngress(hostname=lb_host))
     return svc
 
 
+def _percentile(samples: list, q: float) -> float:
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    idx = min(len(ordered) - 1, max(0, int(q * len(ordered) + 0.999999) - 1))
+    return ordered[idx]
+
+
+def _controller_of(thread_name: str) -> str:
+    for prefix, label in (
+        ("global-accelerator", "globalaccelerator"),
+        ("route53", "route53"),
+        ("endpoint-group", "endpointgroupbinding"),
+    ):
+        if thread_name.startswith(prefix):
+            return label
+    return "other"
+
+
 def run_convergence(
-    workers: int, cache_ttl: float = 0.0, qps: float = 10.0, burst: int = 100
-) -> float:
-    """Create N_SERVICES annotated services, return services/sec until
-    every accelerator chain exists."""
+    n: int,
+    workers: int,
+    cache_ttl: float = 0.0,
+    qps: float = 10.0,
+    burst: int = 100,
+    measure_steady_state: bool = False,
+) -> dict:
+    """Create ``n`` annotated services, converge the accelerator chains
+    AND Route53 record pairs, and return a result dict with throughput,
+    per-controller sync-latency percentiles, AWS call counts, and
+    (optionally) the steady-state call rate over one resync cycle."""
     cluster = FakeCluster()
-    aws = LatencyAWS()
+    aws = ShapedAWS()
     cache = DiscoveryCache(ttl=cache_ttl) if cache_ttl > 0 else None
-    for i in range(N_SERVICES):
+    for i in range(n):
         aws.add_load_balancer(
             f"bench{i:04d}",
             "us-west-2",
             f"bench{i:04d}-0123456789abcdef.elb.us-west-2.amazonaws.com",
         )
+    zones = [aws.add_hosted_zone(f"z{k}.bench.example.com") for k in range(N_ZONES)]
+
+    latencies: dict[str, list] = {}
+    lat_lock = threading.Lock()
+
+    def observer(key: str, seconds: float, err) -> None:
+        label = _controller_of(threading.current_thread().name)
+        with lat_lock:
+            latencies.setdefault(label, []).append(seconds)
+
     stop = threading.Event()
     config = ControllerConfig(
         global_accelerator=GlobalAcceleratorConfig(
@@ -111,28 +302,106 @@ def run_convergence(
             workers=workers, queue_qps=qps, queue_burst=burst
         ),
     )
-    manager = Manager(resync_period=300)
-    manager.run(
-        cluster,
-        config,
-        stop,
-        cloud_factory=lambda region: AWSDriver(aws, aws, aws, discovery_cache=cache),
-        block=False,
-    )
-    for i in range(N_SERVICES):
-        cluster.create("Service", make_service(i))
-    start = time.monotonic()
-    deadline = start + 300
-    while time.monotonic() < deadline:
-        if len(aws.all_accelerator_arns()) >= N_SERVICES:
-            break
-        time.sleep(0.01)
-    elapsed = time.monotonic() - start
-    stop.set()
-    done = len(aws.all_accelerator_arns())
-    if done < N_SERVICES:
-        raise SystemExit(f"benchmark did not converge: {done}/{N_SERVICES}")
-    return N_SERVICES / elapsed
+    manager = Manager(resync_period=RESYNC_PERIOD)
+    add_sync_duration_observer(observer)
+    try:
+        manager.run(
+            cluster,
+            config,
+            stop,
+            cloud_factory=lambda region: AWSDriver(
+                aws,
+                aws,
+                aws,
+                discovery_cache=cache,
+                # the reference requeues every 60 s until the GA
+                # controller has converged (route53.go:63-77); scaled
+                accelerator_missing_retry=60.0 / LATENCY_SCALE,
+            ),
+            block=False,
+        )
+        for i in range(n):
+            cluster.create("Service", make_service(i))
+        start = time.monotonic()
+        deadline = start + DEADLINE
+
+        def converged() -> bool:
+            if len(aws.all_accelerator_arns()) < n:
+                return False
+            records = sum(len(aws.records_in_zone(z.id)) for z in zones)
+            return records >= 2 * n
+
+        while time.monotonic() < deadline:
+            if converged():
+                break
+            time.sleep(0.05)
+        elapsed = time.monotonic() - start
+        if not converged():
+            done = len(aws.all_accelerator_arns())
+            records = sum(len(aws.records_in_zone(z.id)) for z in zones)
+            raise SystemExit(
+                f"benchmark did not converge: {done}/{n} accelerators, "
+                f"{records}/{2 * n} records"
+            )
+
+        steady = None
+        if measure_steady_state:
+            # Let the convergence tail drain, then count every AWS call
+            # over one full resync cycle: the converged level-triggered
+            # re-reconcile rate — what the account pays per 30 s for N
+            # services of drift verification.
+            time.sleep(2.0)
+            calls_before = aws.total_calls()
+            window_start = time.monotonic()
+            time.sleep(STEADY_WINDOW)
+            window = time.monotonic() - window_start
+            steady = {
+                "window_s": round(window, 1),
+                "aws_calls": aws.total_calls() - calls_before,
+                "aws_calls_per_sec": round((aws.total_calls() - calls_before) / window, 2),
+                "resync_period_s": RESYNC_PERIOD,
+                # 0 is correct, not a broken probe: resync re-delivers
+                # update(old, new) with old == new, and both this
+                # framework and the reference skip equal updates
+                # (reference controller.go:100-102 reflect.DeepEqual),
+                # so a converged fleet is AWS-quiescent between edits
+                "note": "converged level-triggered quiescence; equal resync updates are skipped (parity: reference controller.go:100-102)",
+            }
+    finally:
+        remove_sync_duration_observer(observer)
+        stop.set()
+
+    with lat_lock:
+        sync_latency = {
+            label: {
+                "p50_s": round(_percentile(vals, 0.50), 4),
+                "p99_s": round(_percentile(vals, 0.99), 4),
+                "n_syncs": len(vals),
+            }
+            for label, vals in sorted(latencies.items())
+            if label != "other"
+        }
+    throttled = {
+        family: bucket.throttled_waits for family, bucket in aws._buckets.items()
+    }
+    result = {
+        "services_per_sec": round(n / elapsed, 2),
+        "elapsed_s": round(elapsed, 1),
+        "n_services": n,
+        "workers": workers,
+        "queue_qps": qps,
+        "queue_burst": burst,
+        "discovery_cache_ttl_s": cache_ttl,
+        "aws_calls_total": aws.total_calls(),
+        "aws_calls_by_op": dict(sorted(aws.op_counts.items())),
+        "throttled_acquisitions": throttled,
+        "sync_latency": sync_latency,
+    }
+    if cache is not None:
+        result["discovery_cache"] = {"hits": cache.hits, "misses": cache.misses}
+    if steady is not None:
+        result["steady_state"] = steady
+    return result
 
 
 def main():
@@ -142,20 +411,54 @@ def main():
     logging.getLogger("agac").setLevel(logging.CRITICAL)
     # baseline: the reference's operating point — 1 worker per queue,
     # client-go's fixed 10 qps/100 burst enqueue bucket, full O(N)+1
-    # tag-scan discovery on every reconcile
-    baseline = run_convergence(workers=1, cache_ttl=0.0, qps=10.0, burst=100)
+    # tag-scan discovery on every reconcile (N_BASELINE services; see
+    # module docstring for why the subset favors the baseline)
+    baseline = run_convergence(N_BASELINE, workers=1, cache_ttl=0.0, qps=10.0, burst=100)
     # measured: this framework's tuned production configuration —
-    # concurrent workers (32 ≈ the IO-bound sweet spot; 64 regresses on
-    # contention), raised enqueue bucket (--queue-qps/--queue-burst),
-    # and the incremental discovery cache (AGAC_DISCOVERY_CACHE_TTL)
-    value = run_convergence(workers=32, cache_ttl=5.0, qps=1000.0, burst=1000)
+    # concurrent workers, raised enqueue bucket, incremental discovery
+    # cache (AGAC_DISCOVERY_CACHE_TTL) — against the full N.  Under
+    # the realistic quota model throughput is quota-bound and plateaus
+    # from 8 workers up (10.50 at w=8 → 11.17 at w=64 svc/s,
+    # docs/operations.md "Sizing the worker pool"); 32 sits near the
+    # plateau top, while the docs recommend 8–16 where p99 matters
+    tuned = run_convergence(
+        N_SERVICES,
+        workers=TUNED_WORKERS,
+        # 30 s: with the write journal the cache never masks local
+        # writes, so TTL only bounds cross-process staleness — the
+        # same 30 s the reference tolerates between informer resyncs
+        cache_ttl=30.0,
+        qps=1000.0,
+        burst=1000,
+        measure_steady_state=True,
+    )
+    steady = tuned.pop("steady_state")
     print(
         json.dumps(
             {
                 "metric": "service_to_accelerator_convergence_throughput",
-                "value": round(value, 2),
+                "value": tuned["services_per_sec"],
                 "unit": "services/sec",
-                "vs_baseline": round(value / baseline, 2),
+                "vs_baseline": round(
+                    tuned["services_per_sec"] / baseline["services_per_sec"], 2
+                ),
+                "detail": {
+                    "workload": (
+                        "each Service needs an accelerator+listener+endpoint-group "
+                        "chain AND an atomic TXT+A Route53 record pair"
+                    ),
+                    "baseline": baseline,
+                    "tuned": tuned,
+                    "steady_state": steady,
+                    "latency_model": {
+                        "scale": f"real-world seconds / {LATENCY_SCALE:g}; quotas x{LATENCY_SCALE:g}",
+                        "real_latency_s": REAL_LATENCY,
+                        "quotas_scaled_per_sec": {
+                            family: {"rate": rate, "burst": burst_}
+                            for family, (rate, burst_) in QUOTAS.items()
+                        },
+                    },
+                },
             }
         )
     )

@@ -178,3 +178,84 @@ def test_upsert_blocks_stale_inflight_load():
     # reloads or serves a snapshot containing the upserted entry
     snapshot = cache.get(lambda: [(acc, [])])
     assert any(a.accelerator_arn == "arn:new" for a, _ in snapshot)
+
+
+def test_single_flight_load():
+    """Concurrent missers issue ONE scan; the rest wait for it
+    (storm behavior: 32 workers must not run 32 O(N) scans)."""
+    import threading
+
+    cache = DiscoveryCache(ttl=60.0)
+    started = threading.Event()
+    release = threading.Event()
+    loads = []
+
+    def slow_loader():
+        loads.append(1)
+        started.set()
+        release.wait(5.0)
+        return []
+
+    results = []
+    threads = [
+        threading.Thread(target=lambda: results.append(cache.get(slow_loader)))
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    assert started.wait(5.0)
+    release.set()
+    for t in threads:
+        t.join(5.0)
+    assert len(loads) == 1  # one scan served all eight workers
+    assert len(results) == 8
+    assert cache.misses == 1 and cache.hits == 7
+
+
+def test_journal_merges_storm_writes_into_loaded_snapshot():
+    """A write during an in-flight load is folded into the stored
+    snapshot (not discarded): the next get() is a HIT that sees the
+    write — creation storms stay O(N), not O(N^2)."""
+    from agac_tpu.cloudprovider.aws.types import Accelerator
+
+    cache = DiscoveryCache(ttl=60.0)
+    acc = Accelerator(
+        accelerator_arn="arn:during-load", name="n", enabled=True,
+        status="DEPLOYED", dns_name="d",
+    )
+
+    def loader_with_concurrent_write():
+        cache.upsert(acc, [])  # write lands mid-scan
+        return []  # the scan's (stale) view
+
+    merged = cache.get(loader_with_concurrent_write)
+    assert any(a.accelerator_arn == "arn:during-load" for a, _ in merged)
+    hits_before = cache.hits
+    again = cache.get(lambda: pytest.fail("must be served from cache"))
+    assert cache.hits == hits_before + 1
+    assert any(a.accelerator_arn == "arn:during-load" for a, _ in again)
+
+
+def test_invalidate_during_load_prevents_store():
+    """invalidate (external change) mid-load: the result is returned
+    but NOT stored — the next get() rescans."""
+    cache = DiscoveryCache(ttl=60.0)
+
+    def loader_with_concurrent_invalidate():
+        cache.invalidate()
+        return []
+
+    cache.get(loader_with_concurrent_invalidate)
+    loads = []
+    cache.get(lambda: loads.append(1) or [])
+    assert loads == [1]  # rescan, not a hit
+
+
+def test_failed_load_releases_single_flight():
+    """A loader exception must not wedge the single-flight latch."""
+    cache = DiscoveryCache(ttl=60.0)
+    with pytest.raises(RuntimeError):
+        cache.get(lambda: (_ for _ in ()).throw(RuntimeError("scan failed")))
+    loads = []
+    cache.get(lambda: loads.append(1) or [])  # next load proceeds
+    assert loads == [1]

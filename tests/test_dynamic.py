@@ -134,6 +134,93 @@ class TestServerSideApply:
         )
 
 
+class TestApplyConflictSemantics:
+    """Field-manager conflict contract (VERDICT r3 next#3): the server
+    tracks per-leaf-path ownership and can REFUSE — a second manager
+    applying an owned field is 409 without force, takeover with it
+    (the contract the reference drives with ``Force: true``,
+    ``e2e/pkg/util/manifests.go:120-141``)."""
+
+    def test_overlap_without_force_is_409_naming_the_owner(
+        self, ssa_server, dynamic
+    ):
+        dynamic.apply(service_manifest(port=80), field_manager="mgr-a")
+        with pytest.raises(DynamicApplyError) as excinfo:
+            dynamic.apply(
+                service_manifest(port=443), field_manager="mgr-b", force=False
+            )
+        assert excinfo.value.status == 409
+        # the Status body names the owning manager and the field
+        # (quotes arrive JSON-escaped inside the wire body)
+        assert 'conflict with \\"mgr-a\\"' in str(excinfo.value)
+        assert ".spec.ports" in str(excinfo.value)
+        # the refused apply changed nothing
+        assert dynamic.get(service_manifest())["spec"]["ports"][0]["port"] == 80
+        assert (
+            ssa_server.apply_managers[("Service", "default", "dyn-svc")] == "mgr-a"
+        )
+
+    def test_force_takes_over_and_records_new_manager(self, ssa_server, dynamic):
+        dynamic.apply(service_manifest(port=80), field_manager="mgr-a")
+        taken = dynamic.apply(
+            service_manifest(port=443), field_manager="mgr-b", force=True
+        )
+        assert taken["spec"]["ports"][0]["port"] == 443
+        # fieldManager recorded on takeover (the VERDICT's explicit ask)
+        assert (
+            ssa_server.apply_managers[("Service", "default", "dyn-svc")] == "mgr-b"
+        )
+        # ownership genuinely transferred: the ORIGINAL manager now
+        # needs force for the same field
+        with pytest.raises(DynamicApplyError) as excinfo:
+            dynamic.apply(
+                service_manifest(port=8080), field_manager="mgr-a", force=False
+            )
+        assert excinfo.value.status == 409
+        assert 'conflict with \\"mgr-b\\"' in str(excinfo.value)
+
+    def test_disjoint_fields_coexist_without_force(self, ssa_server, dynamic):
+        """Two managers owning different fields never conflict — the
+        conflict check is per leaf path, not per object."""
+        dynamic.apply(service_manifest(port=80), field_manager="mgr-a")
+        labeled = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": "dyn-svc",
+                "namespace": "default",
+                "labels": {"team": "b"},
+            },
+        }
+        merged = dynamic.apply(labeled, field_manager="mgr-b", force=False)
+        assert merged["metadata"]["labels"] == {"team": "b"}
+        assert merged["spec"]["ports"][0]["port"] == 80
+        # same value, owned field: still a conflict (real SSA conflicts
+        # between appliers regardless of the value being applied)
+        with pytest.raises(DynamicApplyError) as excinfo:
+            dynamic.apply(service_manifest(port=80), field_manager="mgr-b", force=False)
+        assert excinfo.value.status == 409
+
+    def test_same_manager_reapply_never_conflicts(self, dynamic):
+        dynamic.apply(service_manifest(port=80), field_manager="mgr-a")
+        again = dynamic.apply(
+            service_manifest(port=443), field_manager="mgr-a", force=False
+        )
+        assert again["spec"]["ports"][0]["port"] == 443
+
+    def test_delete_clears_ownership(self, ssa_server, dynamic):
+        """A future namesake starts with a clean managedFields slate."""
+        dynamic.apply(service_manifest(port=80), field_manager="mgr-a")
+        dynamic.delete(service_manifest())
+        fresh = dynamic.apply(
+            service_manifest(port=443), field_manager="mgr-b", force=False
+        )
+        assert fresh["spec"]["ports"][0]["port"] == 443
+        assert (
+            ssa_server.apply_managers[("Service", "default", "dyn-svc")] == "mgr-b"
+        )
+
+
 class TestCreateOrReplaceFallback:
     """The FALLBACK branch: servers answering 501 to the PATCH verb
     (pre-SSA apiservers; the in-repo server before this round)."""

@@ -249,3 +249,56 @@ class TestOnSyncErrorHook:
             queue, lambda k: Obj(k, {}), lambda k: pytest.fail(), process, bad_hook
         )
         assert any(c[0] == "add_rate_limited" for c in queue.calls)
+
+
+class TestSyncDurationObserver:
+    """The process-global metrics seam: observers see (key, seconds,
+    error) for every completed sync pass (the reference only logs the
+    duration at v4, ``reconcile.go:44-47``)."""
+
+    def test_observer_sees_success_and_failure(self, queue):
+        from agac_tpu.reconcile import (
+            add_sync_duration_observer,
+            remove_sync_duration_observer,
+        )
+
+        seen = []
+        observer = lambda key, seconds, err: seen.append((key, seconds, err))
+        add_sync_duration_observer(observer)
+        try:
+            queue.add("ns/ok")
+            process_next_work_item(
+                queue, lambda k: Obj(k, {}), lambda k: pytest.fail(),
+                lambda obj: Result(),
+            )
+            boom = RuntimeError("boom")
+            queue.add("ns/fail")
+            process_next_work_item(
+                queue, lambda k: Obj(k, {}), lambda k: pytest.fail(),
+                lambda obj: (_ for _ in ()).throw(boom),
+            )
+        finally:
+            remove_sync_duration_observer(observer)
+        assert [s[0] for s in seen] == ["ns/ok", "ns/fail"]
+        assert all(s[1] >= 0 for s in seen)
+        assert seen[0][2] is None and seen[1][2] is boom
+
+    def test_observer_exception_contained_and_removal_idempotent(self, queue):
+        from agac_tpu.reconcile import (
+            add_sync_duration_observer,
+            remove_sync_duration_observer,
+        )
+
+        def bad_observer(key, seconds, err):
+            raise ValueError("observer bug")
+
+        add_sync_duration_observer(bad_observer)
+        try:
+            queue.add("ns/ok")
+            assert process_next_work_item(
+                queue, lambda k: Obj(k, {}), lambda k: pytest.fail(),
+                lambda obj: Result(),
+            )
+        finally:
+            remove_sync_duration_observer(bad_observer)
+        remove_sync_duration_observer(bad_observer)  # no-op, no raise
