@@ -29,6 +29,7 @@ Two reference bugs are replicated by *intent*, not literally:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import time
 from typing import Callable, Optional
@@ -101,13 +102,36 @@ def accelerator_tags_from_annotations(obj) -> list[Tag]:
     return tags
 
 
+# GA's CreateAccelerator Name limit (GA API reference): 64 chars max
+_ACCELERATOR_NAME_MAX = 64
+
+
 def accelerator_name(resource: str, obj) -> str:
     """Annotation override, else ``<resource>-<ns>-<name>``
-    (reference ``global_accelerator.go:53-60``)."""
+    (reference ``global_accelerator.go:53-60``), clamped to GA's
+    64-char Name limit.
+
+    Kubernetes allows 63-char namespaces and 253-char names, so the
+    derived string can exceed what CreateAccelerator accepts; the
+    reference sends it raw and real AWS rejects it with
+    InvalidArgumentException, permanently wedging that item (intent
+    fix, SURVEY.md §7 — see PARITY.md).  Long names keep a 55-char
+    prefix plus an 8-hex digest of the full identity, so the clamp is
+    deterministic (drift detection via ``_accelerator_changed`` stays
+    stable) and two long names differing only in the tail stay
+    distinct.  Correctness never depends on Name: ownership discovery
+    is tag-based (``accelerator_owner_tag_value`` carries the full,
+    unclamped identity).  The user-supplied annotation override is
+    passed through untouched — an invalid explicit choice should fail
+    loudly at AWS, not be silently rewritten."""
     name = obj.metadata.annotations.get(apis.AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION, "")
     if name:
         return name
-    return f"{resource}-{obj.metadata.namespace}-{obj.metadata.name}"
+    name = f"{resource}-{obj.metadata.namespace}-{obj.metadata.name}"
+    if len(name) <= _ACCELERATOR_NAME_MAX:
+        return name
+    digest = hashlib.sha256(name.encode()).hexdigest()[:8]
+    return f"{name[:_ACCELERATOR_NAME_MAX - 9].rstrip('-.')}-{digest}"
 
 
 def tags_contains_all_values(tags: list[Tag], target: dict[str, str]) -> bool:

@@ -22,6 +22,9 @@ ERR_ASSOCIATED_ENDPOINT_GROUP_FOUND = "AssociatedEndpointGroupFoundException"
 ERR_LOAD_BALANCER_NOT_FOUND = "LoadBalancerNotFound"
 ERR_NO_SUCH_HOSTED_ZONE = "NoSuchHostedZone"
 ERR_INVALID_CHANGE_BATCH = "InvalidChangeBatch"
+ERR_INVALID_ARGUMENT = "InvalidArgumentException"
+ERR_INVALID_PORT_RANGE = "InvalidPortRangeException"
+ERR_LIMIT_EXCEEDED = "LimitExceededException"
 
 
 class AWSAPIError(Exception):

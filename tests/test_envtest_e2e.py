@@ -347,7 +347,10 @@ class TestScaleThroughHTTP:
         queue throughput exercised together over live HTTP."""
         n = 600
         client = RestClusterClient(server.url)
-        aws = FakeAWSBackend()
+        # a 600-accelerator fleet needs a raised account quota, the
+        # same service-quota increase a real account of this size runs
+        # with; every other AWS invariant stays enforced at defaults
+        aws = FakeAWSBackend(quota_accelerators=n + 10)
         for i in range(n):
             host = f"big{i:04d}-0123456789abcdef.elb.us-west-2.amazonaws.com"
             aws.add_load_balancer(f"big{i:04d}", NLB_REGION, host)

@@ -1,0 +1,326 @@
+"""FakeAWSBackend enforces documented AWS invariants (VERDICT r3
+next#5): a fake that accepts inputs real AWS rejects certifies
+convergence against a cloud that doesn't exist.  Each test pins one
+documented constraint — name shapes and quotas from the Global
+Accelerator API reference / service-quota tables, record rules from
+the Route53 2013-04-01 API — and the error code real AWS answers
+with.
+
+The companion driver-side test proves the one previously-silent
+invalid input this surfaced: accelerator names derived from long
+Kubernetes identities exceeded GA's 64-char Name limit (the reference
+sends them raw, ``global_accelerator.go:53-60``); ``accelerator_name``
+now clamps deterministically.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.driver import accelerator_name
+from agac_tpu.cloudprovider.aws.errors import (
+    AWSAPIError,
+    ERR_INVALID_ARGUMENT,
+    ERR_INVALID_CHANGE_BATCH,
+    ERR_INVALID_PORT_RANGE,
+    ERR_LIMIT_EXCEEDED,
+)
+from agac_tpu.cloudprovider.aws.types import (
+    Change,
+    EndpointConfiguration,
+    PortRange,
+    ResourceRecord,
+    ResourceRecordSet,
+    Tag,
+)
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+
+
+@pytest.fixture
+def backend():
+    return FakeAWSBackend()
+
+
+def create_accelerator(backend, name="web", tags=()):
+    return backend.create_accelerator(name, "IPV4", True, list(tags))
+
+
+def expect_code(code):
+    return pytest.raises(AWSAPIError, match=code)
+
+
+# ---------------------------------------------------------------------------
+# accelerator name + type
+# ---------------------------------------------------------------------------
+
+class TestAcceleratorValidation:
+    @pytest.mark.parametrize(
+        "bad_name",
+        [
+            "",
+            "a" * 65,                 # > 64 chars
+            "-leading-hyphen",
+            "trailing-hyphen-",
+            ".leading.period",
+            "trailing.period.",
+            "under_score",            # underscore not allowed
+            "sp ace",
+            "uniéode",
+        ],
+    )
+    def test_bad_names_rejected(self, backend, bad_name):
+        with expect_code(ERR_INVALID_ARGUMENT):
+            create_accelerator(backend, name=bad_name)
+
+    @pytest.mark.parametrize(
+        "good_name", ["a", "a" * 64, "svc-default-web", "web.prod.cluster-1"]
+    )
+    def test_good_names_accepted(self, backend, good_name):
+        assert create_accelerator(backend, name=good_name).name == good_name
+
+    def test_update_validates_name_too(self, backend):
+        arn = create_accelerator(backend).accelerator_arn
+        with expect_code(ERR_INVALID_ARGUMENT):
+            backend.update_accelerator(arn, name="-bad")
+
+    def test_bad_ip_address_type_rejected(self, backend):
+        with expect_code(ERR_INVALID_ARGUMENT):
+            backend.create_accelerator("web", "IPV6", True, [])
+
+    def test_account_accelerator_quota(self):
+        backend = FakeAWSBackend(quota_accelerators=2)
+        create_accelerator(backend, "one")
+        create_accelerator(backend, "two")
+        with expect_code(ERR_LIMIT_EXCEEDED):
+            create_accelerator(backend, "three")
+
+    def test_tag_quota_on_create_and_merge(self):
+        backend = FakeAWSBackend(quota_tags_per_resource=3)
+        with expect_code(ERR_LIMIT_EXCEEDED):
+            create_accelerator(
+                backend, tags=[Tag(f"k{i}", "v") for i in range(4)]
+            )
+        arn = create_accelerator(
+            backend, tags=[Tag("k0", "v"), Tag("k1", "v")]
+        ).accelerator_arn
+        # merge that would EXCEED the quota fails...
+        with expect_code(ERR_LIMIT_EXCEEDED):
+            backend.tag_resource(arn, [Tag("k2", "v"), Tag("k3", "v")])
+        # ...but re-tagging existing keys (a merge, not growth) is fine
+        backend.tag_resource(arn, [Tag("k0", "v2"), Tag("k2", "v")])
+
+
+# ---------------------------------------------------------------------------
+# listeners
+# ---------------------------------------------------------------------------
+
+class TestListenerValidation:
+    @pytest.mark.parametrize(
+        "port_ranges,code",
+        [
+            ([], ERR_INVALID_ARGUMENT),
+            ([PortRange(0, 80)], ERR_INVALID_PORT_RANGE),
+            ([PortRange(80, 65536)], ERR_INVALID_PORT_RANGE),
+            ([PortRange(443, 80)], ERR_INVALID_PORT_RANGE),  # From > To
+            ([(80, 80)], ERR_INVALID_ARGUMENT),  # not a PortRange shape
+        ],
+    )
+    def test_bad_port_ranges(self, backend, port_ranges, code):
+        arn = create_accelerator(backend).accelerator_arn
+        with expect_code(code):
+            backend.create_listener(arn, port_ranges, "TCP", "NONE")
+
+    def test_port_ranges_per_listener_quota(self, backend):
+        arn = create_accelerator(backend).accelerator_arn
+        ranges = [PortRange(1000 + i, 1000 + i) for i in range(11)]
+        with expect_code(ERR_LIMIT_EXCEEDED):
+            backend.create_listener(arn, ranges, "TCP", "NONE")
+        backend.create_listener(arn, ranges[:10], "TCP", "NONE")  # at quota: fine
+
+    def test_protocol_and_affinity_validated(self, backend):
+        arn = create_accelerator(backend).accelerator_arn
+        with expect_code(ERR_INVALID_ARGUMENT):
+            backend.create_listener(arn, [PortRange(80, 80)], "HTTP", "NONE")
+        with expect_code(ERR_INVALID_ARGUMENT):
+            backend.create_listener(arn, [PortRange(80, 80)], "TCP", "STICKY")
+
+    def test_update_listener_validates(self, backend):
+        arn = create_accelerator(backend).accelerator_arn
+        listener = backend.create_listener(arn, [PortRange(80, 80)], "TCP", "NONE")
+        with expect_code(ERR_INVALID_PORT_RANGE):
+            backend.update_listener(listener.listener_arn, [PortRange(0, 0)], "TCP", "NONE")
+
+    def test_listeners_per_accelerator_quota(self):
+        backend = FakeAWSBackend(quota_listeners_per_accelerator=2)
+        arn = create_accelerator(backend).accelerator_arn
+        backend.create_listener(arn, [PortRange(80, 80)], "TCP", "NONE")
+        backend.create_listener(arn, [PortRange(81, 81)], "TCP", "NONE")
+        with expect_code(ERR_LIMIT_EXCEEDED):
+            backend.create_listener(arn, [PortRange(82, 82)], "TCP", "NONE")
+
+
+# ---------------------------------------------------------------------------
+# endpoint groups
+# ---------------------------------------------------------------------------
+
+class TestEndpointGroupValidation:
+    @pytest.fixture
+    def listener_arn(self, backend):
+        arn = create_accelerator(backend).accelerator_arn
+        return backend.create_listener(arn, [PortRange(80, 80)], "TCP", "NONE").listener_arn
+
+    def test_region_required(self, backend, listener_arn):
+        with expect_code(ERR_INVALID_ARGUMENT):
+            backend.create_endpoint_group(listener_arn, "", [])
+
+    def test_endpoint_id_and_weight_validated(self, backend, listener_arn):
+        with expect_code(ERR_INVALID_ARGUMENT):
+            backend.create_endpoint_group(
+                listener_arn, NLB_REGION, [EndpointConfiguration(endpoint_id="")]
+            )
+        with expect_code(ERR_INVALID_ARGUMENT):
+            backend.create_endpoint_group(
+                listener_arn, NLB_REGION,
+                [EndpointConfiguration(endpoint_id="arn:lb", weight=256)],
+            )
+
+    def test_endpoints_per_group_quota(self, listener_arn):
+        backend_small = FakeAWSBackend(quota_endpoints_per_group=2)
+        arn = create_accelerator(backend_small).accelerator_arn
+        lis = backend_small.create_listener(arn, [PortRange(80, 80)], "TCP", "NONE")
+        eg = backend_small.create_endpoint_group(
+            lis.listener_arn, NLB_REGION,
+            [EndpointConfiguration(endpoint_id=f"arn:lb{i}") for i in range(2)],
+        )
+        with expect_code(ERR_LIMIT_EXCEEDED):
+            backend_small.add_endpoints(
+                eg.endpoint_group_arn, [EndpointConfiguration(endpoint_id="arn:lb9")]
+            )
+        # re-adding an EXISTING endpoint is an update, not growth
+        backend_small.add_endpoints(
+            eg.endpoint_group_arn,
+            [EndpointConfiguration(endpoint_id="arn:lb0", weight=10)],
+        )
+
+    def test_endpoint_groups_per_listener_quota(self, backend, listener_arn):
+        backend.quota_endpoint_groups_per_listener = 1
+        backend.create_endpoint_group(listener_arn, NLB_REGION, [])
+        with expect_code(ERR_LIMIT_EXCEEDED):
+            backend.create_endpoint_group(listener_arn, "us-east-1", [])
+
+
+# ---------------------------------------------------------------------------
+# Route53 change batches
+# ---------------------------------------------------------------------------
+
+class TestChangeBatchValidation:
+    @pytest.fixture
+    def zone(self, backend):
+        return backend.add_hosted_zone("example.com")
+
+    @staticmethod
+    def txt(name, value='"owner"', ttl=300):
+        return ResourceRecordSet(
+            name=name, type="TXT", ttl=ttl,
+            resource_records=[ResourceRecord(value)],
+        )
+
+    def test_empty_batch_rejected(self, backend, zone):
+        with expect_code(ERR_INVALID_CHANGE_BATCH):
+            backend.change_resource_record_sets(zone.id, [])
+
+    def test_batch_size_limit(self, zone, backend):
+        backend.quota_changes_per_batch = 2
+        changes = [
+            Change("CREATE", self.txt(f"r{i}.example.com")) for i in range(3)
+        ]
+        with expect_code(ERR_INVALID_CHANGE_BATCH):
+            backend.change_resource_record_sets(zone.id, changes)
+
+    def test_invalid_record_type_rejected(self, backend, zone):
+        bad = ResourceRecordSet(
+            name="x.example.com", type="BOGUS", ttl=300,
+            resource_records=[ResourceRecord("v")],
+        )
+        with expect_code(ERR_INVALID_CHANGE_BATCH):
+            backend.change_resource_record_sets(zone.id, [Change("CREATE", bad)])
+
+    def test_ttl_bounds(self, backend, zone):
+        with expect_code(ERR_INVALID_CHANGE_BATCH):
+            backend.change_resource_record_sets(
+                zone.id, [Change("CREATE", self.txt("x.example.com", ttl=-1))]
+            )
+        with expect_code(ERR_INVALID_CHANGE_BATCH):
+            backend.change_resource_record_sets(
+                zone.id, [Change("CREATE", self.txt("x.example.com", ttl=2**31))]
+            )
+
+    def test_non_alias_record_needs_ttl(self, backend, zone):
+        naked = ResourceRecordSet(
+            name="x.example.com", type="TXT",
+            resource_records=[ResourceRecord("v")],
+        )
+        with expect_code(ERR_INVALID_CHANGE_BATCH):
+            backend.change_resource_record_sets(zone.id, [Change("CREATE", naked)])
+
+    def test_atomicity_preserved_on_validation_failure(self, backend, zone):
+        """A batch with one invalid change applies NOTHING."""
+        good = self.txt("ok.example.com")
+        bad = ResourceRecordSet(name="", type="TXT", ttl=300,
+                                resource_records=[ResourceRecord("v")])
+        with expect_code(ERR_INVALID_CHANGE_BATCH):
+            backend.change_resource_record_sets(
+                zone.id, [Change("CREATE", good), Change("CREATE", bad)]
+            )
+        assert backend.records_in_zone(zone.id) == []
+
+
+# ---------------------------------------------------------------------------
+# the driver input this surfaced: long Kubernetes identities
+# ---------------------------------------------------------------------------
+
+class TestLongIdentityAcceleratorName:
+    def test_long_identity_clamps_to_valid_name(self):
+        svc = make_lb_service(name="a-very-long-service-name-" + "x" * 100)
+        name = accelerator_name("service", svc)
+        assert len(name) <= 64
+        assert not name.startswith(("-", ".")) and not name.endswith(("-", "."))
+        # deterministic (drift detection must not flap)
+        assert name == accelerator_name("service", svc)
+
+    def test_long_identities_differing_in_tail_stay_distinct(self):
+        base = "long-prefix-" + "y" * 80
+        a = make_lb_service(name=base + "-alpha")
+        b = make_lb_service(name=base + "-beta")
+        assert accelerator_name("service", a) != accelerator_name("service", b)
+
+    def test_short_identity_unchanged(self):
+        svc = make_lb_service(name="web")
+        assert accelerator_name("service", svc) == "service-default-web"
+
+    def test_annotation_override_passes_through(self):
+        from agac_tpu import apis
+
+        svc = make_lb_service(name="web")
+        svc.metadata.annotations[apis.AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION] = (
+            "user-picked-name"
+        )
+        assert accelerator_name("service", svc) == "user-picked-name"
+
+    def test_long_identity_converges_against_validating_fake(self):
+        """End-to-end proof: a Service whose namespace+name used to
+        produce a >64-char accelerator name now creates its chain
+        against a fake that enforces the real limit."""
+        long_name = "payments-frontend-" + "z" * 120
+        hostname = f"longsvc-0123456789abcdef.elb.{NLB_REGION}.amazonaws.com"
+        backend = FakeAWSBackend()
+        backend.add_load_balancer("longsvc", NLB_REGION, hostname)
+        driver = AWSDriver(backend, backend, backend)
+        svc = make_lb_service(name=long_name, hostname=hostname)
+        arn, created, retry = driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "default", "longsvc", NLB_REGION
+        )
+        assert created and retry == 0.0
+        assert len(backend.describe_accelerator(arn).name) <= 64

@@ -49,7 +49,13 @@ class TestSoakChurn:
     def test_churn_then_convergence_quiescence_no_residue(self):
         rng = random.Random(20260729)
         cluster = FakeCluster()
-        aws = FakeAWSBackend()
+        # churn can briefly hold two accelerators for a recreated slot
+        # (deletes apply asynchronously), so give the validating fake
+        # headroom above the 26 slots instead of riding the default
+        # 20-accelerator quota edge
+        aws = FakeAWSBackend(
+            quota_accelerators=N_SERVICE_SLOTS + N_INGRESS_SLOTS + 10
+        )
         zone = aws.add_hosted_zone("example.com")
         for i in range(N_SERVICE_SLOTS):
             aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
