@@ -11,68 +11,127 @@
 #   KEEP_CLUSTER=1 ./hack/kind-e2e.sh                # leave cluster running
 #   E2E_KIND_SOAK=1 ./hack/kind-e2e.sh               # include apiserver-restart soak
 #   HELM_STAGE=1 ./hack/kind-e2e.sh                  # also build image + helm install
+#   DRY_RUN=1 ./hack/kind-e2e.sh                     # print every command, execute none
 #
-# Requirements: kind, kubectl, docker, openssl, python (repo deps).
+# Requirements: kind, kubectl, docker, openssl, python (repo deps);
+# helm additionally when HELM_STAGE=1.  The preflight below fails
+# fast with the FULL list of whatever is missing.  DRY_RUN=1 needs
+# none of them: it prints the exact command flow (with placeholder
+# values where a live cluster would be probed) so the script's logic
+# can be audited — and is unit-tested on every `make test` — without
+# docker (tests/test_kind_script.py).
 set -o errexit
 
 K8S_VERSION="${K8S_VERSION:-1.31.0}"
 CLUSTER_NAME="${CLUSTER_NAME:-agac-e2e}"
 WEBHOOK_PORT="${WEBHOOK_PORT:-18443}"
-WORKDIR="$(mktemp -d)"
+DRY_RUN="${DRY_RUN:-0}"
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+
+# --- preflight -----------------------------------------------------------
+# collect EVERY missing tool before failing, so one run reports the
+# complete shopping list instead of dying on the first gap
+required="kind kubectl docker openssl python"
+if [ "${HELM_STAGE:-0}" = "1" ]; then
+  required="${required} helm"
+fi
+missing=""
+for tool in ${required}; do
+  command -v "${tool}" >/dev/null 2>&1 || missing="${missing} ${tool}"
+done
+if [ -n "${missing}" ]; then
+  if [ "${DRY_RUN}" = "1" ]; then
+    echo "preflight (dry-run, continuing): missing binaries:${missing}" >&2
+  else
+    echo "kind-e2e preflight: missing required binaries:${missing}" >&2
+    echo "install them (see the header of hack/kind-e2e.sh), then re-run" >&2
+    exit 3
+  fi
+fi
+
+# every effectful command goes through run(): always echoed (a trace
+# for CI logs), executed unless DRY_RUN=1
+run() {
+  printf '+ %s\n' "$*"
+  if [ "${DRY_RUN}" = "1" ]; then
+    return 0
+  fi
+  "$@"
+}
+
+BANNER_SUFFIX=""
+if [ "${DRY_RUN}" = "1" ]; then
+  BANNER_SUFFIX=" [dry-run: nothing executed]"
+fi
+
+WORKDIR="$(mktemp -d)"
 
 cleanup() {
   if [ "${KEEP_CLUSTER:-0}" != "1" ]; then
-    kind delete cluster --name "${CLUSTER_NAME}" || true
+    run kind delete cluster --name "${CLUSTER_NAME}" || true
   fi
   rm -rf "${WORKDIR}"
 }
 trap cleanup EXIT
 
 # --- cluster -------------------------------------------------------------
-kind create cluster --name "${CLUSTER_NAME}" \
+run kind create cluster --name "${CLUSTER_NAME}" \
   --image "kindest/node:v${K8S_VERSION}" --wait 120s
-kubectl cluster-info --context "kind-${CLUSTER_NAME}"
+run kubectl cluster-info --context "kind-${CLUSTER_NAME}"
 
 # --- webhook TLS material ------------------------------------------------
 # The webhook runs on the host; the apiserver (inside the kind node
 # container) reaches it via the docker network gateway.  Issue a cert
 # for that IP with a throwaway CA whose bundle goes into the
 # ValidatingWebhookConfiguration.
-HOST_IP="$(docker network inspect kind -f '{{(index .IPAM.Config 0).Gateway}}')"
-if [ -z "${HOST_IP}" ]; then
-  echo "could not determine docker network gateway for 'kind'" >&2
-  exit 1
+printf '+ %s\n' "docker network inspect kind -f '{{(index .IPAM.Config 0).Gateway}}'"
+if [ "${DRY_RUN}" = "1" ]; then
+  HOST_IP="<docker-network-gateway>"
+else
+  HOST_IP="$(docker network inspect kind -f '{{(index .IPAM.Config 0).Gateway}}')"
+  if [ -z "${HOST_IP}" ]; then
+    echo "could not determine docker network gateway for 'kind'" >&2
+    exit 1
+  fi
 fi
-openssl req -x509 -newkey rsa:2048 -nodes -days 2 \
+run openssl req -x509 -newkey rsa:2048 -nodes -days 2 \
   -keyout "${WORKDIR}/ca.key" -out "${WORKDIR}/ca.crt" \
-  -subj "/CN=agac-e2e-ca" >/dev/null 2>&1
-openssl req -newkey rsa:2048 -nodes \
+  -subj "/CN=agac-e2e-ca"
+run openssl req -newkey rsa:2048 -nodes \
   -keyout "${WORKDIR}/webhook.key" -out "${WORKDIR}/webhook.csr" \
-  -subj "/CN=agac-e2e-webhook" >/dev/null 2>&1
+  -subj "/CN=agac-e2e-webhook"
 cat > "${WORKDIR}/san.cnf" <<EOF
 subjectAltName=IP:${HOST_IP}
 EOF
-openssl x509 -req -in "${WORKDIR}/webhook.csr" \
+run openssl x509 -req -in "${WORKDIR}/webhook.csr" \
   -CA "${WORKDIR}/ca.crt" -CAkey "${WORKDIR}/ca.key" -CAcreateserial \
   -days 2 -extfile "${WORKDIR}/san.cnf" \
-  -out "${WORKDIR}/webhook.crt" >/dev/null 2>&1
+  -out "${WORKDIR}/webhook.crt"
 
-E2E_WEBHOOK_CA_BUNDLE="$(base64 < "${WORKDIR}/ca.crt" | tr -d '\n')"
+printf '+ %s\n' "base64 < ${WORKDIR}/ca.crt | tr -d '\\n'"
+if [ "${DRY_RUN}" = "1" ]; then
+  E2E_WEBHOOK_CA_BUNDLE="<ca-bundle-base64>"
+else
+  E2E_WEBHOOK_CA_BUNDLE="$(base64 < "${WORKDIR}/ca.crt" | tr -d '\n')"
+fi
 
 # --- protocol tier -------------------------------------------------------
 KUBECONFIG_FILE="${WORKDIR}/kubeconfig"
-kind get kubeconfig --name "${CLUSTER_NAME}" > "${KUBECONFIG_FILE}"
+printf '+ %s\n' "kind get kubeconfig --name ${CLUSTER_NAME} > ${KUBECONFIG_FILE}"
+if [ "${DRY_RUN}" != "1" ]; then
+  kind get kubeconfig --name "${CLUSTER_NAME}" > "${KUBECONFIG_FILE}"
+fi
 
 cd "${REPO_ROOT}"
-E2E_KIND=1 \
-KUBECONFIG="${KUBECONFIG_FILE}" \
-E2E_WEBHOOK_URL="https://${HOST_IP}:${WEBHOOK_PORT}" \
-E2E_WEBHOOK_CERT="${WORKDIR}/webhook.crt" \
-E2E_WEBHOOK_KEY="${WORKDIR}/webhook.key" \
-E2E_WEBHOOK_CA_BUNDLE="${E2E_WEBHOOK_CA_BUNDLE}" \
-E2E_KIND_NODE="${CLUSTER_NAME}-control-plane" \
-python -m pytest tests/test_kind_e2e.py -v
+run env \
+  E2E_KIND=1 \
+  KUBECONFIG="${KUBECONFIG_FILE}" \
+  E2E_WEBHOOK_URL="https://${HOST_IP}:${WEBHOOK_PORT}" \
+  E2E_WEBHOOK_CERT="${WORKDIR}/webhook.crt" \
+  E2E_WEBHOOK_KEY="${WORKDIR}/webhook.key" \
+  E2E_WEBHOOK_CA_BUNDLE="${E2E_WEBHOOK_CA_BUNDLE}" \
+  E2E_KIND_NODE="${CLUSTER_NAME}-control-plane" \
+  python -m pytest tests/test_kind_e2e.py -v
 
 # --- optional: image + helm chart deploy proof (VERDICT r2 next#4) -------
 # Installs the chart with BOTH processes enabled (controller on the
@@ -82,31 +141,31 @@ python -m pytest tests/test_kind_e2e.py -v
 # through the chart's webhook Service.
 if [ "${HELM_STAGE:-0}" = "1" ]; then
   IMAGE="aws-global-accelerator-controller:e2e"
-  docker build -t "${IMAGE}" "${REPO_ROOT}"
-  kind load docker-image "${IMAGE}" --name "${CLUSTER_NAME}"
+  run docker build -t "${IMAGE}" "${REPO_ROOT}"
+  run kind load docker-image "${IMAGE}" --name "${CLUSTER_NAME}"
 
   KC="kubectl --kubeconfig ${KUBECONFIG_FILE}"
 
   # serving cert for the in-cluster webhook Service DNS name, signed
   # by the same throwaway CA as the host-webhook cert above
   WEBHOOK_SVC="aws-global-accelerator-controller-webhook"
-  openssl req -newkey rsa:2048 -nodes \
+  run openssl req -newkey rsa:2048 -nodes \
     -keyout "${WORKDIR}/chart-webhook.key" -out "${WORKDIR}/chart-webhook.csr" \
-    -subj "/CN=${WEBHOOK_SVC}.default.svc" >/dev/null 2>&1
+    -subj "/CN=${WEBHOOK_SVC}.default.svc"
   cat > "${WORKDIR}/chart-san.cnf" <<EOF
 subjectAltName=DNS:${WEBHOOK_SVC}.default.svc,DNS:${WEBHOOK_SVC}.default.svc.cluster.local
 EOF
-  openssl x509 -req -in "${WORKDIR}/chart-webhook.csr" \
+  run openssl x509 -req -in "${WORKDIR}/chart-webhook.csr" \
     -CA "${WORKDIR}/ca.crt" -CAkey "${WORKDIR}/ca.key" -CAcreateserial \
     -days 2 -extfile "${WORKDIR}/chart-san.cnf" \
-    -out "${WORKDIR}/chart-webhook.crt" >/dev/null 2>&1
-  ${KC} create secret tls agac-e2e-webhook-cert \
+    -out "${WORKDIR}/chart-webhook.crt"
+  run ${KC} create secret tls agac-e2e-webhook-cert \
     --cert "${WORKDIR}/chart-webhook.crt" --key "${WORKDIR}/chart-webhook.key"
 
   # LB name/hostname pair from tests/fixtures.py, so the fake cloud
   # recognizes the hostname we patch into the sample Service's status
   NLB_HOSTNAME="testlb-0123456789abcdef.elb.us-west-2.amazonaws.com"
-  helm install agac "${REPO_ROOT}/charts/aws-global-accelerator-controller" \
+  run helm install agac "${REPO_ROOT}/charts/aws-global-accelerator-controller" \
     --kubeconfig "${KUBECONFIG_FILE}" \
     --set image.repository=aws-global-accelerator-controller \
     --set image.tag=e2e \
@@ -118,50 +177,56 @@ EOF
     --set env.AGAC_CLOUD=fake \
     --set env.AGAC_FAKE_LBS="testlb=${NLB_HOSTNAME}" \
     --set env.AGAC_FAKE_ZONES="example.com."
-  ${KC} rollout status deployment/aws-global-accelerator-controller --timeout=180s
-  ${KC} rollout status deployment/${WEBHOOK_SVC} --timeout=180s
+  run ${KC} rollout status deployment/aws-global-accelerator-controller --timeout=180s
+  run ${KC} rollout status deployment/${WEBHOOK_SVC} --timeout=180s
 
   # reconcile proof: give the sample Service an LB hostname through
   # the status subresource (kind has no cloud LB controller — we play
   # aws-load-balancer-controller, same trick as test_kind_e2e.py) and
   # wait for the chart-deployed controller's Event
-  ${KC} apply -f "${REPO_ROOT}/config/samples/nlb-public-service.yaml"
-  ${KC} patch service sample-nlb --subresource=status --type=merge \
+  run ${KC} apply -f "${REPO_ROOT}/config/samples/nlb-public-service.yaml"
+  run ${KC} patch service sample-nlb --subresource=status --type=merge \
     -p "{\"status\":{\"loadBalancer\":{\"ingress\":[{\"hostname\":\"${NLB_HOSTNAME}\"}]}}}"
-  i=0
-  until ${KC} get events \
-      --field-selector reason=GlobalAcceleratorCreated,involvedObject.name=sample-nlb \
-      -o name 2>/dev/null | grep -q .; do
-    i=$((i+1))
-    if [ "$i" -gt 60 ]; then
-      echo "HELM_STAGE: no GlobalAcceleratorCreated Event after 120s" >&2
-      ${KC} logs deployment/aws-global-accelerator-controller --tail=100 >&2 || true
-      exit 1
-    fi
-    sleep 2
-  done
+  printf '+ %s\n' "poll: ${KC} get events --field-selector reason=GlobalAcceleratorCreated,involvedObject.name=sample-nlb -o name (120s budget)"
+  if [ "${DRY_RUN}" != "1" ]; then
+    i=0
+    until ${KC} get events \
+        --field-selector reason=GlobalAcceleratorCreated,involvedObject.name=sample-nlb \
+        -o name 2>/dev/null | grep -q .; do
+      i=$((i+1))
+      if [ "$i" -gt 60 ]; then
+        echo "HELM_STAGE: no GlobalAcceleratorCreated Event after 120s" >&2
+        ${KC} logs deployment/aws-global-accelerator-controller --tail=100 >&2 || true
+        exit 1
+      fi
+      sleep 2
+    done
+  fi
 
   # admission proof: the chart's ValidatingWebhookConfiguration +
   # webhook Service must allow a weight change and deny an ARN change
   # with the reference's exact message (e2e/e2e_test.go:78-98)
-  ${KC} apply -f "${REPO_ROOT}/config/samples/endpointgroupbinding.yaml"
-  ${KC} patch endpointgroupbinding sample-binding --type=merge \
+  run ${KC} apply -f "${REPO_ROOT}/config/samples/endpointgroupbinding.yaml"
+  run ${KC} patch endpointgroupbinding sample-binding --type=merge \
     -p '{"spec":{"weight":64}}'
-  if ${KC} patch endpointgroupbinding sample-binding --type=merge \
-      -p '{"spec":{"endpointGroupArn":"arn:aws:globalaccelerator::123456789012:accelerator/changed"}}' \
-      2> "${WORKDIR}/deny.err"; then
-    echo "HELM_STAGE: ARN mutation was NOT denied by the chart webhook" >&2
-    exit 1
+  printf '+ %s\n' "expect-denial: ${KC} patch endpointgroupbinding sample-binding --type=merge -p '{\"spec\":{\"endpointGroupArn\":\"arn:aws:globalaccelerator::123456789012:accelerator/changed\"}}' (stderr must contain 'immutable')"
+  if [ "${DRY_RUN}" != "1" ]; then
+    if ${KC} patch endpointgroupbinding sample-binding --type=merge \
+        -p '{"spec":{"endpointGroupArn":"arn:aws:globalaccelerator::123456789012:accelerator/changed"}}' \
+        2> "${WORKDIR}/deny.err"; then
+      echo "HELM_STAGE: ARN mutation was NOT denied by the chart webhook" >&2
+      exit 1
+    fi
+    grep -q "immutable" "${WORKDIR}/deny.err" || {
+      echo "HELM_STAGE: denial lacked the immutability message:" >&2
+      cat "${WORKDIR}/deny.err" >&2
+      exit 1
+    }
   fi
-  grep -q "immutable" "${WORKDIR}/deny.err" || {
-    echo "HELM_STAGE: denial lacked the immutability message:" >&2
-    cat "${WORKDIR}/deny.err" >&2
-    exit 1
-  }
 
   # leader election through the chart's RBAC
-  ${KC} get lease aws-global-accelerator-controller -o yaml
-  echo "HELM_STAGE PASSED (reconcile Event + webhook denial through the chart)"
+  run ${KC} get lease aws-global-accelerator-controller -o yaml
+  echo "HELM_STAGE PASSED (reconcile Event + webhook denial through the chart)${BANNER_SUFFIX}"
 fi
 
-echo "kind e2e tier PASSED (k8s ${K8S_VERSION})"
+echo "kind e2e tier PASSED (k8s ${K8S_VERSION})${BANNER_SUFFIX}"
