@@ -66,6 +66,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="Enqueue burst size per workqueue (token bucket capacity).",
     )
     controller.add_argument(
+        "--drift-resync-period", type=float, default=0.0,
+        help="Re-enqueue every managed object each N seconds so AWS-side "
+        "drift (out-of-band disable/delete/record edits) is repaired "
+        "without a Kubernetes object change. 0 (default) matches the "
+        "reference: drift waits for an object edit.",
+    )
+    controller.add_argument(
         "--queue-max-backoff", type=float, default=1000.0,
         help="Cap on the per-item exponential retry backoff in seconds "
         "(client-go's default 1000 is far past useful for external-API "
@@ -137,6 +144,7 @@ def run_controller(args) -> int:
         "queue_qps": args.queue_qps,
         "queue_burst": args.queue_burst,
         "queue_max_backoff": args.queue_max_backoff,
+        "drift_resync_period": args.drift_resync_period,
     }
     config = ControllerConfig(
         global_accelerator=GlobalAcceleratorConfig(

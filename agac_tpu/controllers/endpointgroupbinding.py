@@ -45,6 +45,7 @@ from .common import (
     lb_name_region_or_warn,
     make_sync_error_warner,
     run_workers,
+    start_drift_resync,
 )
 
 CONTROLLER_AGENT_NAME = "endpoint-group-binding-controller"
@@ -58,6 +59,8 @@ class EndpointGroupBindingConfig:
     queue_burst: int = 100
     # per-item exponential backoff cap (client-go default 1000 s)
     queue_max_backoff: float = 1000.0
+    # see GlobalAcceleratorConfig.drift_resync_period; 0 = reference parity
+    drift_resync_period: float = 0.0
 
 
 class EndpointGroupBindingController:
@@ -70,6 +73,7 @@ class EndpointGroupBindingController:
     ):
         self._client = client
         self._workers = config.workers
+        self._drift_resync_period = config.drift_resync_period
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
         self.workqueue = RateLimitingQueue(
@@ -120,6 +124,14 @@ class EndpointGroupBindingController:
             on_sync_result=make_sync_error_warner(self.recorder, self._key_to_binding),
         )
         klog.info("Started workers")
+        # plain dedup add, not add_rate_limited — see the
+        # GlobalAccelerator controller's resync comment
+        start_drift_resync(
+            CONTROLLER_AGENT_NAME, stop, self._drift_resync_period,
+            # every EndpointGroupBinding is managed (no annotation gate)
+            [(self.binding_lister, lambda b: True,
+              lambda b: self.workqueue.add(meta_namespace_key(b)))],
+        )
         stop.wait()
         klog.info("Shutting down workers")
         self.workqueue.shutdown()

@@ -34,12 +34,28 @@ from .common import (
     lb_name_region_or_warn,
     make_sync_error_warner,
     run_workers,
+    start_drift_resync,
     unwrap_tombstone,
     was_alb_ingress,
     was_load_balancer_service,
 )
 
 CONTROLLER_AGENT_NAME = "global-accelerator-controller"
+
+
+def is_managed_service(svc) -> bool:
+    """The single managed-Service predicate — shared by the informer
+    add handler and the drift-resync ticker so the two can never
+    diverge."""
+    return was_load_balancer_service(svc) and has_annotation(
+        svc, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+    )
+
+
+def is_managed_ingress(ingress) -> bool:
+    return was_alb_ingress(ingress) and has_annotation(
+        ingress, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+    )
 
 
 @dataclass
@@ -52,6 +68,11 @@ class GlobalAcceleratorConfig:
     queue_burst: int = 100
     # per-item exponential backoff cap (client-go default 1000 s)
     queue_max_backoff: float = 1000.0
+    # re-enqueue every managed object each N seconds so AWS-side
+    # drift is repaired without a Kubernetes edit; 0 (default) =
+    # reference parity: equal resync updates are skipped and
+    # out-of-band drift waits for an object change
+    drift_resync_period: float = 0.0
 
 
 class GlobalAcceleratorController:
@@ -64,6 +85,7 @@ class GlobalAcceleratorController:
     ):
         self.cluster_name = config.cluster_name
         self._workers = config.workers
+        self._drift_resync_period = config.drift_resync_period
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
         self.service_queue = RateLimitingQueue(
@@ -100,9 +122,7 @@ class GlobalAcceleratorController:
     # event handlers (reference ``controller.go:91-173``)
     # ------------------------------------------------------------------
     def _add_service_notification(self, svc) -> None:
-        if was_load_balancer_service(svc) and has_annotation(
-            svc, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
-        ):
+        if is_managed_service(svc):
             klog.v(4).infof(
                 "Service %s/%s is created", svc.metadata.namespace, svc.metadata.name
             )
@@ -135,9 +155,7 @@ class GlobalAcceleratorController:
             self._enqueue(self.service_queue, svc)
 
     def _add_ingress_notification(self, ingress) -> None:
-        if was_alb_ingress(ingress) and has_annotation(
-            ingress, apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
-        ):
+        if is_managed_ingress(ingress):
             klog.v(4).infof(
                 "Ingress %s/%s is created",
                 ingress.metadata.namespace,
@@ -206,6 +224,26 @@ class GlobalAcceleratorController:
             on_sync_result=make_sync_error_warner(self.recorder, self._key_to_ingress),
         )
         klog.info("Started workers")
+        # resync ticks use the plain dedup add, NOT add_rate_limited:
+        # the client-go resync pattern.  Metered adds would drain the
+        # shared enqueue bucket (starving event-driven reconciles on
+        # large fleets) and bump per-item failure counts of items
+        # mid-retry-backoff.
+        start_drift_resync(
+            CONTROLLER_AGENT_NAME, stop, self._drift_resync_period,
+            [
+                (
+                    self.service_lister,
+                    is_managed_service,
+                    lambda svc: self.service_queue.add(meta_namespace_key(svc)),
+                ),
+                (
+                    self.ingress_lister,
+                    is_managed_ingress,
+                    lambda ing: self.ingress_queue.add(meta_namespace_key(ing)),
+                ),
+            ],
+        )
         stop.wait()
         klog.info("Shutting down workers")
         self.service_queue.shutdown()

@@ -34,11 +34,25 @@ from .common import (
     lb_name_region_or_warn,
     make_sync_error_warner,
     run_workers,
+    start_drift_resync,
     unwrap_tombstone,
     was_load_balancer_service,
 )
 
 CONTROLLER_AGENT_NAME = "route53-controller"
+
+
+def is_hostname_managed_service(svc) -> bool:
+    """The single managed-Service predicate — shared by the informer
+    add handler and the drift-resync ticker so the two can never
+    diverge."""
+    return was_load_balancer_service(svc) and has_annotation(
+        svc, apis.ROUTE53_HOSTNAME_ANNOTATION
+    )
+
+
+def is_hostname_managed_ingress(ingress) -> bool:
+    return has_annotation(ingress, apis.ROUTE53_HOSTNAME_ANNOTATION)
 
 
 @dataclass
@@ -49,6 +63,8 @@ class Route53Config:
     queue_burst: int = 100
     # per-item exponential backoff cap (client-go default 1000 s)
     queue_max_backoff: float = 1000.0
+    # see GlobalAcceleratorConfig.drift_resync_period; 0 = reference parity
+    drift_resync_period: float = 0.0
 
 
 class Route53Controller:
@@ -61,6 +77,7 @@ class Route53Controller:
     ):
         self.cluster_name = config.cluster_name
         self._workers = config.workers
+        self._drift_resync_period = config.drift_resync_period
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
         self.service_queue = RateLimitingQueue(
@@ -177,6 +194,23 @@ class Route53Controller:
             on_sync_result=make_sync_error_warner(self.recorder, self._key_to_ingress),
         )
         klog.info("Started workers")
+        # plain dedup add, not add_rate_limited — see the
+        # GlobalAccelerator controller's resync comment
+        start_drift_resync(
+            CONTROLLER_AGENT_NAME, stop, self._drift_resync_period,
+            [
+                (
+                    self.service_lister,
+                    is_hostname_managed_service,
+                    lambda svc: self.service_queue.add(meta_namespace_key(svc)),
+                ),
+                (
+                    self.ingress_lister,
+                    is_hostname_managed_ingress,
+                    lambda ing: self.ingress_queue.add(meta_namespace_key(ing)),
+                ),
+            ],
+        )
         stop.wait()
         klog.info("Shutting down workers")
         self.service_queue.shutdown()

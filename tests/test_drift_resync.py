@@ -1,0 +1,264 @@
+"""Drift-resync tier: repairing AWS-side drift without a Kubernetes
+edit.
+
+Both this framework and the reference skip resync updates where
+``old == new`` (reference ``globalaccelerator/controller.go:100-102``,
+``reflect.DeepEqual``), so an accelerator disabled, an endpoint group
+deleted, or a Route53 record edited OUT-OF-BAND is never repaired
+until someone touches the Kubernetes object.  ``--drift-resync-period``
+(``drift_resync_period`` on every controller config) closes that gap:
+a ticker re-enqueues every managed object so the 3-level drift ensure
+re-runs against AWS.  Default 0 keeps exact reference behavior —
+asserted here too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.controllers import (
+    EndpointGroupBindingConfig,
+    GlobalAcceleratorConfig,
+    Route53Config,
+)
+from agac_tpu.manager import ControllerConfig
+from agac_tpu.controllers.common import start_drift_resync
+from agac_tpu.cluster import FakeCluster
+from agac_tpu.manager import Manager
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+
+DRIFT_PERIOD = 0.2
+
+
+def wait_until(probe, timeout=15.0, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if probe():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {message}")
+
+
+@pytest.fixture
+def aws():
+    backend = FakeAWSBackend()
+    backend.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+    backend.add_hosted_zone("example.com")
+    return backend
+
+
+def run_manager(aws, drift_period: float):
+    cluster = FakeCluster()
+    stop = threading.Event()
+    config = ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(
+            workers=2, drift_resync_period=drift_period
+        ),
+        route53=Route53Config(workers=1, drift_resync_period=drift_period),
+        endpoint_group_binding=EndpointGroupBindingConfig(
+            workers=1, drift_resync_period=drift_period
+        ),
+    )
+    manager = Manager(resync_period=300)
+    manager.run(
+        cluster, config, stop,
+        cloud_factory=lambda region: AWSDriver(aws, aws, aws),
+        block=False,
+    )
+    return cluster, stop
+
+
+class TestDriftRepair:
+    def test_disabled_accelerator_is_reenabled(self, aws):
+        cluster, stop = run_manager(aws, DRIFT_PERIOD)
+        try:
+            cluster.create("Service", make_lb_service())
+            wait_until(lambda: aws.all_accelerator_arns(), message="create")
+            arn = aws.all_accelerator_arns()[0]
+            # out-of-band tampering: someone disables it in the console
+            aws.update_accelerator(arn, enabled=False)
+            wait_until(
+                lambda: aws.describe_accelerator(arn).enabled,
+                message="drift resync to re-enable the accelerator",
+            )
+        finally:
+            stop.set()
+
+    def test_deleted_endpoint_group_is_recreated(self, aws):
+        cluster, stop = run_manager(aws, DRIFT_PERIOD)
+        try:
+            cluster.create("Service", make_lb_service())
+            wait_until(lambda: aws.all_accelerator_arns(), message="create")
+            arn = aws.all_accelerator_arns()[0]
+
+            def group_arns():
+                state = aws._accelerators[arn]
+                return [
+                    eg_arn for eg_arn, parent in aws._eg_parent.items()
+                    if parent in state.listeners
+                ]
+
+            wait_until(lambda: group_arns(), message="endpoint group")
+            aws.delete_endpoint_group(group_arns()[0])  # out-of-band
+            wait_until(
+                lambda: group_arns(),
+                message="drift resync to recreate the endpoint group",
+            )
+        finally:
+            stop.set()
+
+    def test_deleted_route53_records_are_recreated(self, aws):
+        zone = next(iter(aws._zones.values()))
+        cluster, stop = run_manager(aws, DRIFT_PERIOD)
+        try:
+            svc = make_lb_service(
+                annotations={
+                    "external-dns.alpha.kubernetes.io/hostname": "www.example.com"
+                }
+            )
+            # fixtures merge annotations; ensure the exact key the
+            # controller watches is present
+            from agac_tpu import apis
+
+            svc.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = (
+                "www.example.com"
+            )
+            cluster.create("Service", svc)
+            wait_until(
+                lambda: len(aws.records_in_zone(zone.id)) >= 2,
+                message="TXT+A pair",
+            )
+            # out-of-band: both records deleted behind the controller
+            from agac_tpu.cloudprovider.aws.types import Change
+
+            for record in aws.records_in_zone(zone.id):
+                aws.change_resource_record_sets(
+                    zone.id, [Change("DELETE", record)]
+                )
+            assert aws.records_in_zone(zone.id) == []
+            wait_until(
+                lambda: len(aws.records_in_zone(zone.id)) >= 2,
+                message="drift resync to recreate the record pair",
+            )
+        finally:
+            stop.set()
+
+    def test_default_zero_matches_reference_behavior(self, aws):
+        """Opt-in means OFF by default: tampering stays unrepaired
+        until the Kubernetes object changes (the reference's exact
+        semantics), then the update event repairs it."""
+        cluster, stop = run_manager(aws, drift_period=0.0)
+        try:
+            cluster.create("Service", make_lb_service())
+            wait_until(lambda: aws.all_accelerator_arns(), message="create")
+            arn = aws.all_accelerator_arns()[0]
+            aws.update_accelerator(arn, enabled=False)
+            time.sleep(0.8)  # several would-be drift periods
+            assert not aws.describe_accelerator(arn).enabled  # NOT repaired
+            # a Kubernetes edit triggers the repair, as in the reference
+            svc = cluster.get("Service", "default", "web")
+            svc.metadata.labels["touch"] = "1"
+            cluster.update("Service", svc)
+            wait_until(
+                lambda: aws.describe_accelerator(arn).enabled,
+                message="repair after object change",
+            )
+        finally:
+            stop.set()
+
+
+class TestResyncBypassesEnqueueBucket:
+    def test_repair_not_starved_by_tiny_queue_bucket(self, aws):
+        """Resync ticks use the plain dedup add (client-go pattern),
+        NOT add_rate_limited: with a nearly-empty shared enqueue
+        bucket, metered resync adds would defer repair by minutes and
+        starve event-driven reconciles on large fleets."""
+        cluster = FakeCluster()
+        stop = threading.Event()
+        config = ControllerConfig(
+            global_accelerator=GlobalAcceleratorConfig(
+                workers=2, drift_resync_period=DRIFT_PERIOD,
+                # bucket so slow a metered resync add would wait ~minutes
+                queue_qps=0.05, queue_burst=2,
+            ),
+            route53=Route53Config(workers=1, queue_qps=0.05, queue_burst=2),
+            endpoint_group_binding=EndpointGroupBindingConfig(workers=1),
+        )
+        Manager(resync_period=300).run(
+            cluster, config, stop,
+            cloud_factory=lambda region: AWSDriver(aws, aws, aws),
+            block=False,
+        )
+        try:
+            cluster.create("Service", make_lb_service())
+            wait_until(lambda: aws.all_accelerator_arns(), message="create")
+            arn = aws.all_accelerator_arns()[0]
+            aws.update_accelerator(arn, enabled=False)
+            start = time.monotonic()
+            wait_until(
+                lambda: aws.describe_accelerator(arn).enabled,
+                timeout=5.0,
+                message="repair despite a drained enqueue bucket",
+            )
+            assert time.monotonic() - start < 5.0
+        finally:
+            stop.set()
+
+
+class TestTickerUnit:
+    def test_zero_period_starts_nothing(self):
+        stop = threading.Event()
+        assert start_drift_resync("t", stop, 0.0, []) is None
+
+    def test_enqueues_only_matching_objects(self):
+        stop = threading.Event()
+        seen = []
+
+        class StaticLister:
+            def __init__(self, objs):
+                self._objs = objs
+
+            def list(self):
+                return list(self._objs)
+
+        thread = start_drift_resync(
+            "t", stop, 0.05,
+            [(StaticLister(["managed", "other"]),
+              lambda o: o == "managed", seen.append)],
+        )
+        try:
+            wait_until(lambda: len(seen) >= 2, message="ticks")
+            assert set(seen) == {"managed"}
+        finally:
+            stop.set()
+            thread.join(2)
+
+    def test_tick_exception_contained(self):
+        stop = threading.Event()
+        seen = []
+
+        class BrokenLister:
+            def list(self):
+                raise RuntimeError("lister broke")
+
+        class OkLister:
+            def list(self):
+                return ["x"]
+
+        thread = start_drift_resync(
+            "t", stop, 0.05,
+            [(BrokenLister(), lambda o: True, seen.append),
+             (OkLister(), lambda o: True, seen.append)],
+        )
+        try:
+            # the broken source must not kill the ticker or starve the
+            # healthy one
+            wait_until(lambda: len(seen) >= 2, message="ticks despite failure")
+        finally:
+            stop.set()
+            thread.join(2)
