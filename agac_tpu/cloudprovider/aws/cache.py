@@ -54,6 +54,92 @@ from .types import Accelerator, Tag
 Snapshot = list[tuple[Accelerator, list[Tag]]]
 
 
+class HostedZoneCache:
+    """TTL snapshot of ALL hosted zones, so ``get_hosted_zone``'s
+    parent-domain walk (reference ``route53.go:334-358``) runs in
+    memory instead of costing ~2 ListHostedZonesByName probes per
+    Route53 ensure — half the Route53 quota spend under the
+    shaped-latency bench, against a zone set that is created by
+    humans and changes about never.
+
+    Staleness is handled at the callers, cheaply: a hostname that
+    does NOT resolve in the snapshot falls back to a live walk (a
+    zone created moments ago is still found, and the stale snapshot
+    is dropped); a cached zone that was deleted out-of-band surfaces
+    as NoSuchHostedZone on first use, which invalidates the snapshot
+    so the retry re-reads.  Loads are single-flight: concurrent
+    missers wait for one zone list instead of issuing their own."""
+
+    def __init__(self, ttl: float = 60.0, clock: Callable[[], float] = time.monotonic):
+        self._ttl = ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._zones: Optional[list] = None
+        self._by_name: Optional[dict] = None
+        self._expires = 0.0
+        self._load_event: Optional[threading.Event] = None
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _build_index(zones: list) -> dict:
+        """name → zone, NAME-SORTED first-wins: Route53 allows
+        duplicate zone names, and the live ListHostedZonesByName probe
+        (max_items=1) returns the name-ordered first — sorting before
+        setdefault keeps the cached walk's winner identical to the
+        probe's regardless of ListHostedZones iteration order."""
+        by_name: dict = {}
+        for zone in sorted(zones, key=lambda z: z.name):
+            by_name.setdefault(zone.name, zone)
+        return by_name
+
+    def zones(self, loader: Callable[[], list]) -> list:
+        """The zone snapshot, loading through ``loader`` (a full
+        ListHostedZones drain) when absent or expired."""
+        while True:
+            with self._lock:
+                if self._zones is not None and self._clock() < self._expires:
+                    self.hits += 1
+                    return self._zones
+                if self._load_event is None:
+                    self._load_event = event = threading.Event()
+                    self.misses += 1
+                    break
+                event = self._load_event
+            event.wait()
+        try:
+            zones = list(loader())
+        except BaseException:
+            with self._lock:
+                self._load_event = None
+            event.set()
+            raise
+        with self._lock:
+            self._zones = zones
+            self._by_name = self._build_index(zones)
+            self._expires = self._clock() + self._ttl
+            self._load_event = None
+        event.set()
+        return zones
+
+    def zone_index(self, loader: Callable[[], list]) -> dict:
+        """The name → zone index for the current snapshot, built once
+        per load (not per walk)."""
+        zones = self.zones(loader)
+        with self._lock:
+            if self._zones is zones and self._by_name is not None:
+                return self._by_name
+        # the snapshot changed between zones() and here (rare):
+        # build from the list this caller actually holds
+        return self._build_index(zones)
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._zones = None
+            self._by_name = None
+            self._expires = 0.0
+
+
 class DiscoveryCache:
     def __init__(self, ttl: float = 5.0, clock: Callable[[], float] = time.monotonic):
         self._ttl = ttl

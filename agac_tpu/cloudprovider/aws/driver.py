@@ -302,6 +302,7 @@ class AWSDriver:
         lb_not_active_retry: float = LB_NOT_ACTIVE_RETRY,
         accelerator_missing_retry: float = ACCELERATOR_MISSING_RETRY,
         discovery_cache=None,
+        zone_cache=None,
     ):
         self.ga = ga
         self.elbv2 = elbv2
@@ -315,6 +316,9 @@ class AWSDriver:
         # short-circuits the O(N)+1 tag-scan discovery the reference
         # performs on every reconcile
         self._discovery_cache = discovery_cache
+        # optional shared HostedZoneCache: short-circuits the 2-probe
+        # parent-domain zone walk every Route53 ensure repeats
+        self._zone_cache = zone_cache
 
     # ------------------------------------------------------------------
     # ELBv2
@@ -877,67 +881,117 @@ class AWSDriver:
         owner_value = Route53OwnerValue(cluster_name, resource, ns, name)
         created = False
         for hostname in hostnames:
-            hosted_zone = self.get_hosted_zone(hostname)
-            klog.infof("HostedZone is %s", hosted_zone.id)
-            klog.infof(
-                "Finding record sets %r for HostedZone %s", owner_value, hosted_zone.id
-            )
-            record_sets = self._list_record_sets(hosted_zone.id)
-            records = self._owned_alias_record_sets(record_sets, owner_value)
-            klog.v(4).infof("Finding A record %s in %r", hostname, records)
-            record = find_a_record(records, hostname)
-            if record is None:
-                klog.infof(
-                    "Creating record for %s with %s", hostname, accelerator.accelerator_arn
+            try:
+                created |= self._ensure_route53_hostname(
+                    hostname, owner_value, accelerator
                 )
-                # The reference creates the TXT then the A in two CREATE
-                # calls (``route53.go:101-113``); a failure between them
-                # strands a TXT that wedges every retry (CREATE of an
-                # existing record is InvalidChangeBatch).  Intent, not
-                # bug (SURVEY.md §7): submit both in ONE change batch —
-                # Route53 batches are atomic, so the pair commits or
-                # fails together.  A TXT we already own (stranded by an
-                # older torn write) is upserted WITH its existing values
-                # preserved (one TXT record set per name — co-owner
-                # values from other tools must survive); a foreign TXT
-                # still fails loudly rather than being clobbered.
-                existing_txt = next(
-                    (
-                        record_set
-                        for record_set in record_sets
-                        if record_set.type == RR_TYPE_TXT
-                        and replace_wildcards(record_set.name) == hostname + "."
-                    ),
-                    None,
-                )
-                txt_owned = existing_txt is not None and any(
-                    r.value == owner_value for r in existing_txt.resource_records
-                )
-                self._create_record_pair(
-                    hosted_zone,
-                    hostname,
-                    [r.value for r in existing_txt.resource_records]
-                    if txt_owned
-                    else [owner_value],
-                    accelerator,
-                    txt_action=CHANGE_ACTION_UPSERT if txt_owned else CHANGE_ACTION_CREATE,
-                )
-                created = True
-            else:
-                if not need_records_update(record, accelerator):
-                    klog.infof("Do not need to update for %s, so skip it", record.name)
-                    continue
-                self._change_alias_record(
-                    hosted_zone, hostname, accelerator, CHANGE_ACTION_UPSERT
-                )
-                klog.infof("RecordSet %s is updated", record.name)
+            except AWSAPIError as err:
+                if (
+                    err.code == "NoSuchHostedZone"
+                    and self._zone_cache is not None
+                ):
+                    # a snapshot zone was deleted out-of-band: drop the
+                    # snapshot so the retry re-reads instead of failing
+                    # for the rest of the TTL
+                    self._zone_cache.invalidate()
+                raise
 
         klog.infof("All records are synced for %s %s/%s", resource, ns, name)
         return created, 0.0
 
+    def _ensure_route53_hostname(
+        self, hostname: str, owner_value: str, accelerator: Accelerator
+    ) -> bool:
+        """Ensure the TXT+A pair for ONE hostname; True if created."""
+        hosted_zone = self.get_hosted_zone(hostname)
+        klog.infof("HostedZone is %s", hosted_zone.id)
+        klog.infof(
+            "Finding record sets %r for HostedZone %s", owner_value, hosted_zone.id
+        )
+        record_sets = self._list_record_sets(hosted_zone.id)
+        records = self._owned_alias_record_sets(record_sets, owner_value)
+        klog.v(4).infof("Finding A record %s in %r", hostname, records)
+        record = find_a_record(records, hostname)
+        if record is None:
+            klog.infof(
+                "Creating record for %s with %s", hostname, accelerator.accelerator_arn
+            )
+            # The reference creates the TXT then the A in two CREATE
+            # calls (``route53.go:101-113``); a failure between them
+            # strands a TXT that wedges every retry (CREATE of an
+            # existing record is InvalidChangeBatch).  Intent, not
+            # bug (SURVEY.md §7): submit both in ONE change batch —
+            # Route53 batches are atomic, so the pair commits or
+            # fails together.  A TXT we already own (stranded by an
+            # older torn write) is upserted WITH its existing values
+            # preserved (one TXT record set per name — co-owner
+            # values from other tools must survive); a foreign TXT
+            # still fails loudly rather than being clobbered.
+            existing_txt = next(
+                (
+                    record_set
+                    for record_set in record_sets
+                    if record_set.type == RR_TYPE_TXT
+                    and replace_wildcards(record_set.name) == hostname + "."
+                ),
+                None,
+            )
+            txt_owned = existing_txt is not None and any(
+                r.value == owner_value for r in existing_txt.resource_records
+            )
+            self._create_record_pair(
+                hosted_zone,
+                hostname,
+                [r.value for r in existing_txt.resource_records]
+                if txt_owned
+                else [owner_value],
+                accelerator,
+                txt_action=CHANGE_ACTION_UPSERT if txt_owned else CHANGE_ACTION_CREATE,
+            )
+            return True
+        if not need_records_update(record, accelerator):
+            klog.infof("Do not need to update for %s, so skip it", record.name)
+            return False
+        self._change_alias_record(
+            hosted_zone, hostname, accelerator, CHANGE_ACTION_UPSERT
+        )
+        klog.infof("RecordSet %s is updated", record.name)
+        return False
+
+    def _list_all_hosted_zones(self) -> list[HostedZone]:
+        zones, marker = [], None
+        while True:
+            page, marker = self.route53.list_hosted_zones(100, marker)
+            zones.extend(page)
+            if marker is None:
+                break
+        return zones
+
     def get_hosted_zone(self, original_hostname: str) -> HostedZone:
-        """Walk parent domains until a hosted zone matches
-        (reference ``route53.go:334-358``)."""
+        """Walk parent domains until a hosted zone matches (reference
+        ``route53.go:334-358``).  With the optional shared
+        HostedZoneCache the walk runs in memory against a TTL zone
+        snapshot (one ListHostedZones drain per TTL instead of ~2
+        probes per ensure); a hostname that does not resolve in the
+        snapshot falls back to the live walk — a zone created moments
+        ago is still found, and the stale snapshot is dropped."""
+        if self._zone_cache is None:
+            return self._walk_hosted_zone(original_hostname)
+        by_name = self._zone_cache.zone_index(self._list_all_hosted_zones)
+        target = original_hostname
+        while target:
+            zone = by_name.get(target + ".")
+            if zone is not None:
+                return zone
+            target = parent_domain(target)
+        # absent from the snapshot: possibly created after the load —
+        # the live walk is the source of truth, and finding a zone
+        # there means the snapshot is stale
+        zone = self._walk_hosted_zone(original_hostname)
+        self._zone_cache.invalidate()
+        return zone
+
+    def _walk_hosted_zone(self, original_hostname: str) -> HostedZone:
         target = original_hostname
         while True:
             if not target:
@@ -1075,12 +1129,22 @@ class AWSDriver:
         """Scan every hosted zone for owned A + TXT records and delete
         them (reference ``route53.go:132-165``)."""
         owner_value = Route53OwnerValue(cluster_name, resource, ns, name)
-        zones, marker = [], None
-        while True:
-            page, marker = self.route53.list_hosted_zones(100, marker)
-            zones.extend(page)
-            if marker is None:
-                break
+        if self._zone_cache is not None:
+            zones = self._zone_cache.zones(self._list_all_hosted_zones)
+        else:
+            zones = self._list_all_hosted_zones()
+        try:
+            self._cleanup_owned_records(zones, owner_value)
+        except AWSAPIError as err:
+            if err.code == "NoSuchHostedZone" and self._zone_cache is not None:
+                # a snapshot zone was deleted out-of-band mid-cleanup:
+                # drop the snapshot so the retry re-reads instead of
+                # re-failing for the rest of the TTL (same repair rule
+                # as the ensure path)
+                self._zone_cache.invalidate()
+            raise
+
+    def _cleanup_owned_records(self, zones, owner_value: str) -> None:
         for zone in zones:
             for record in self.find_owned_a_record_sets(zone, owner_value):
                 self.route53.change_resource_record_sets(

@@ -21,7 +21,7 @@ from __future__ import annotations
 import os
 import threading
 
-from .cache import DiscoveryCache
+from .cache import DiscoveryCache, HostedZoneCache
 from .driver import AWSDriver
 from .fake_backend import FakeAWSBackend
 from .load_balancer import get_lb_name_from_hostname
@@ -60,6 +60,41 @@ def _discovery_cache_ttl() -> float:
         ttl = 30.0
     _discovery_ttl = ttl
     return ttl
+
+
+_zone_cache: HostedZoneCache | None = None
+_zone_ttl: float | None = None
+
+
+def _zone_cache_ttl() -> float:
+    global _zone_ttl
+    if _zone_ttl is not None:
+        return _zone_ttl
+    # 60 s: hosted zones are created by humans, not this controller —
+    # the TTL only bounds how long a zone deleted out-of-band keeps
+    # resolving (and the ensure path invalidates explicitly on
+    # NoSuchHostedZone anyway); 0 disables
+    raw = os.environ.get("AGAC_ZONE_CACHE_TTL", "60")
+    try:
+        ttl = float(raw)
+    except ValueError:
+        from ... import klog
+
+        klog.errorf("AGAC_ZONE_CACHE_TTL=%r is not a number; using default 60s", raw)
+        ttl = 60.0
+    _zone_ttl = ttl
+    return ttl
+
+
+def _shared_zone_cache() -> HostedZoneCache | None:
+    global _zone_cache
+    ttl = _zone_cache_ttl()
+    if ttl <= 0:
+        return None
+    with _lock:
+        if _zone_cache is None:
+            _zone_cache = HostedZoneCache(ttl=ttl)
+        return _zone_cache
 
 
 def _shared_discovery_cache() -> DiscoveryCache | None:
@@ -103,12 +138,17 @@ def shared_fake_backend() -> FakeAWSBackend:
 
 def real_cloud_factory(region: str) -> AWSDriver:
     cache = _shared_discovery_cache()
+    zone_cache = _shared_zone_cache()
     if os.environ.get("AGAC_CLOUD") == "fake":
         backend = shared_fake_backend()
-        return AWSDriver(backend, backend, backend, discovery_cache=cache)
+        return AWSDriver(
+            backend, backend, backend,
+            discovery_cache=cache, zone_cache=zone_cache,
+        )
     from .real_backend import RealAWSClients
 
     clients = RealAWSClients.from_environment(region)
     return AWSDriver(
-        clients.ga, clients.elbv2, clients.route53, discovery_cache=cache
+        clients.ga, clients.elbv2, clients.route53,
+        discovery_cache=cache, zone_cache=zone_cache,
     )

@@ -55,7 +55,7 @@ import threading
 import time
 
 from agac_tpu import klog
-from agac_tpu.cloudprovider.aws.cache import DiscoveryCache
+from agac_tpu.cloudprovider.aws.cache import DiscoveryCache, HostedZoneCache
 from agac_tpu.apis import (
     AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
     AWS_LOAD_BALANCER_TYPE_ANNOTATION,
@@ -270,6 +270,7 @@ def run_convergence(
     n: int,
     workers: int,
     cache_ttl: float = 0.0,
+    zone_cache_ttl: float = 0.0,
     qps: float = 10.0,
     burst: int = 100,
     measure_steady_state: bool = False,
@@ -281,6 +282,7 @@ def run_convergence(
     cluster = FakeCluster()
     aws = ShapedAWS()
     cache = DiscoveryCache(ttl=cache_ttl) if cache_ttl > 0 else None
+    zone_cache = HostedZoneCache(ttl=zone_cache_ttl) if zone_cache_ttl > 0 else None
     for i in range(n):
         aws.add_load_balancer(
             f"bench{i:04d}",
@@ -319,6 +321,7 @@ def run_convergence(
                 aws,
                 aws,
                 discovery_cache=cache,
+                zone_cache=zone_cache,
                 # the reference requeues every 60 s until the GA
                 # controller has converged (route53.go:63-77); scaled
                 accelerator_missing_retry=60.0 / LATENCY_SCALE,
@@ -391,6 +394,7 @@ def run_convergence(
     }
     result = {
         "services_per_sec": round(n / elapsed, 2),
+        "zone_cache_ttl_s": zone_cache_ttl,
         "elapsed_s": round(elapsed, 1),
         "n_services": n,
         "workers": workers,
@@ -404,6 +408,8 @@ def run_convergence(
     }
     if cache is not None:
         result["discovery_cache"] = {"hits": cache.hits, "misses": cache.misses}
+    if zone_cache is not None:
+        result["zone_cache"] = {"hits": zone_cache.hits, "misses": zone_cache.misses}
     if steady is not None:
         result["steady_state"] = steady
     return result
@@ -421,11 +427,12 @@ def main():
     baseline = run_convergence(N_BASELINE, workers=1, cache_ttl=0.0, qps=10.0, burst=100)
     # measured: this framework's tuned production configuration —
     # concurrent workers, raised enqueue bucket, incremental discovery
-    # cache (AGAC_DISCOVERY_CACHE_TTL) — against the full N.  Under
-    # the realistic quota model throughput is quota-bound and plateaus
-    # from 8 workers up (10.50 at w=8 → 11.17 at w=64 svc/s,
-    # docs/operations.md "Sizing the worker pool"); 32 sits near the
-    # plateau top, while the docs recommend 8–16 where p99 matters
+    # caches (AGAC_DISCOVERY_CACHE_TTL + AGAC_ZONE_CACHE_TTL) —
+    # against the full N.  Under the realistic quota model throughput
+    # is GA-mutate-quota-bound and plateaus from 8 workers up (15.49
+    # at w=8 → 16.43 at w=32 svc/s, docs/operations.md "Sizing the
+    # worker pool"); 32 sits at the plateau top, while the docs
+    # recommend 8–16 where p99 matters
     tuned = run_convergence(
         N_SERVICES,
         workers=TUNED_WORKERS,
@@ -433,6 +440,7 @@ def main():
         # writes, so TTL only bounds cross-process staleness — the
         # same 30 s the reference tolerates between informer resyncs
         cache_ttl=30.0,
+        zone_cache_ttl=60.0,
         qps=1000.0,
         burst=1000,
         measure_steady_state=True,

@@ -259,3 +259,119 @@ def test_failed_load_releases_single_flight():
     loads = []
     cache.get(lambda: loads.append(1) or [])  # next load proceeds
     assert loads == [1]
+
+
+class TestHostedZoneCache:
+    """The zone-snapshot cache: get_hosted_zone's parent-domain walk
+    runs in memory against one ListHostedZones drain per TTL."""
+
+    def test_walk_served_from_one_snapshot(self, backend):
+        from agac_tpu.cloudprovider.aws.cache import HostedZoneCache
+
+        backend.add_hosted_zone("example.com")
+        backend.add_hosted_zone("apps.example.com")
+        cache = HostedZoneCache(ttl=60.0)
+        driver = AWSDriver(backend, backend, backend, zone_cache=cache)
+        z1 = driver.get_hosted_zone("www.apps.example.com")
+        z2 = driver.get_hosted_zone("api.example.com")
+        z3 = driver.get_hosted_zone("deep.sub.apps.example.com")
+        assert z1.name == "apps.example.com."
+        assert z2.name == "example.com."
+        assert z3.name == "apps.example.com."
+        # exactly one snapshot load served all three walks
+        assert cache.misses == 1 and cache.hits == 2
+
+    def test_snapshot_miss_falls_back_to_live_walk(self, backend):
+        """A zone created after the snapshot is still found (the live
+        walk is the source of truth) and the stale snapshot drops."""
+        from agac_tpu.cloudprovider.aws.cache import HostedZoneCache
+
+        backend.add_hosted_zone("example.com")
+        cache = HostedZoneCache(ttl=60.0)
+        driver = AWSDriver(backend, backend, backend, zone_cache=cache)
+        driver.get_hosted_zone("www.example.com")  # warms the snapshot
+        backend.add_hosted_zone("newzone.net")  # created moments later
+        zone = driver.get_hosted_zone("api.newzone.net")
+        assert zone.name == "newzone.net."
+        # the stale snapshot was dropped: the next walk re-reads
+        misses_before = cache.misses
+        driver.get_hosted_zone("www.example.com")
+        assert cache.misses == misses_before + 1
+
+    def test_absent_zone_raises_like_uncached(self, backend):
+        from agac_tpu.cloudprovider.aws.cache import HostedZoneCache
+        from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+
+        backend.add_hosted_zone("example.com")
+        cache = HostedZoneCache(ttl=60.0)
+        driver = AWSDriver(backend, backend, backend, zone_cache=cache)
+        with pytest.raises(AWSAPIError, match="NoSuchHostedZone"):
+            driver.get_hosted_zone("www.unrelated.org")
+
+    def test_single_flight_zone_load(self):
+        import threading
+
+        from agac_tpu.cloudprovider.aws.cache import HostedZoneCache
+
+        cache = HostedZoneCache(ttl=60.0)
+        started, release, loads = threading.Event(), threading.Event(), []
+
+        def slow_loader():
+            loads.append(1)
+            started.set()
+            release.wait(5.0)
+            return []
+
+        threads = [
+            threading.Thread(target=lambda: cache.zones(slow_loader))
+            for _ in range(6)
+        ]
+        for t in threads:
+            t.start()
+        assert started.wait(5.0)
+        release.set()
+        for t in threads:
+            t.join(5.0)
+        assert len(loads) == 1
+
+    def test_cleanup_scan_uses_snapshot(self, backend):
+        from agac_tpu.cloudprovider.aws.cache import HostedZoneCache
+
+        backend.add_hosted_zone("example.com")
+        cache = HostedZoneCache(ttl=60.0)
+        driver = make_driver(backend, None)
+        driver._zone_cache = cache
+        driver.get_hosted_zone("www.example.com")  # warm
+        before = sum(1 for c in backend.calls if c[0] == "ChangeResourceRecordSets")
+        driver.cleanup_record_set("default", "service", "default", "gone")
+        # the cleanup's all-zones scan came from the snapshot: zero
+        # fresh ListHostedZones beyond the warming load
+        assert cache.misses == 1 and cache.hits >= 1
+        # and a cleanup for an owner with no records mutates nothing
+        after = sum(1 for c in backend.calls if c[0] == "ChangeResourceRecordSets")
+        assert after == before
+
+    def test_cleanup_invalidates_on_out_of_band_zone_delete(self, backend):
+        """A snapshot zone deleted out-of-band fails the cleanup scan
+        with NoSuchHostedZone ONCE; the snapshot is dropped so the
+        retry re-reads instead of re-failing for the rest of the TTL
+        (same repair rule as the ensure path)."""
+        from agac_tpu.cloudprovider.aws.cache import HostedZoneCache
+        from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+
+        zone = backend.add_hosted_zone("example.com")
+        cache = HostedZoneCache(ttl=600.0)
+        driver = make_driver(backend, None)
+        driver._zone_cache = cache
+        driver.get_hosted_zone("www.example.com")  # warm the snapshot
+        # out-of-band: the zone disappears behind the controller
+        with backend._lock:
+            del backend._zones[zone.id]
+            del backend._records[zone.id]
+        with pytest.raises(AWSAPIError, match="NoSuchHostedZone"):
+            driver.cleanup_record_set("default", "service", "default", "web")
+        # the failure dropped the snapshot: the retry reloads and,
+        # with the zone truly gone, scans nothing and succeeds
+        misses_before = cache.misses
+        driver.cleanup_record_set("default", "service", "default", "web")
+        assert cache.misses == misses_before + 1
