@@ -939,6 +939,21 @@ class AWSDriver:
             txt_owned = existing_txt is not None and any(
                 r.value == owner_value for r in existing_txt.resource_records
             )
+            # the mirror-image strand: the ownership TXT was deleted
+            # out-of-band but OUR alias A survived (found by exact
+            # target match, not TXT ownership — the TXT is gone).  A
+            # CREATE of the A would fail the whole atomic batch with
+            # InvalidChangeBatch forever; reclaim our own record with
+            # UPSERT.  An A aliasing anything other than this
+            # accelerator is foreign — CREATE stays and fails loudly
+            # rather than clobbering someone else's record.
+            existing_a = find_a_record(record_sets, hostname)
+            a_ours = (
+                existing_a is not None
+                and existing_a.alias_target is not None
+                and existing_a.alias_target.dns_name.rstrip(".")
+                == accelerator.dns_name.rstrip(".")
+            )
             self._create_record_pair(
                 hosted_zone,
                 hostname,
@@ -947,6 +962,7 @@ class AWSDriver:
                 else [owner_value],
                 accelerator,
                 txt_action=CHANGE_ACTION_UPSERT if txt_owned else CHANGE_ACTION_CREATE,
+                a_action=CHANGE_ACTION_UPSERT if a_ours else CHANGE_ACTION_CREATE,
             )
             return True
         if not need_records_update(record, accelerator):
@@ -1063,12 +1079,16 @@ class AWSDriver:
         txt_values: list[str],
         accelerator: Accelerator,
         txt_action: str,
+        a_action: str,
     ) -> None:
         """TXT ownership record + A alias in one atomic change batch
         (replaces the reference's two separate CREATE calls,
         ``route53.go:240-289`` — see `_ensure_route53` for why).
         ``txt_values`` is the full value set to write — on an UPSERT of
-        an existing owned TXT it carries the surviving co-owner values."""
+        an existing owned TXT it carries the surviving co-owner values;
+        ``a_action`` is UPSERT when a surviving A already aliases this
+        accelerator (TXT deleted out-of-band) so the pair repair never
+        wedges on CREATE-of-existing."""
         self.route53.change_resource_record_sets(
             hosted_zone.id,
             [
@@ -1082,7 +1102,7 @@ class AWSDriver:
                     ),
                 ),
                 Change(
-                    CHANGE_ACTION_CREATE,
+                    a_action,
                     ResourceRecordSet(
                         name=hostname,
                         type=RR_TYPE_A,

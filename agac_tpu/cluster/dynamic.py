@@ -18,7 +18,7 @@ stays testable offline.
 from __future__ import annotations
 
 import json
-from typing import Any, Optional
+from typing import Optional
 
 from .rest import RestClusterClient
 

@@ -19,6 +19,7 @@ import time
 
 import pytest
 
+from agac_tpu import apis
 from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
 from agac_tpu.controllers import (
     EndpointGroupBindingConfig,
@@ -262,3 +263,108 @@ class TestTickerUnit:
         finally:
             stop.set()
             thread.join(2)
+
+
+class TestTamperStorm:
+    """Chaos variant: a converged fleet suffers a storm of OUT-OF-BAND
+    AWS tampering (accelerators disabled, endpoint groups and
+    listeners deleted, record pairs removed) with no Kubernetes
+    changes at all — drift resync alone must reconverge everything.
+    The reference (and this controller at the default period 0) would
+    stay broken indefinitely."""
+
+    def test_fleet_reconverges_after_out_of_band_tampering(self):
+        import random
+
+        from agac_tpu.cloudprovider.aws.types import Change
+
+        from .test_chaos_e2e import chain_complete, nlb_hostname
+        from .test_resilience_e2e import start_manager, wait_until
+
+        n = 4
+        rng = random.Random(20260729)
+        cluster = FakeCluster()
+        aws = FakeAWSBackend()
+        zone = aws.add_hosted_zone("example.com")
+        for i in range(n):
+            aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+            cluster.create(
+                "Service",
+                make_lb_service(
+                    name=f"svc{i}",
+                    hostname=nlb_hostname(i),
+                    annotations={
+                        apis.ROUTE53_HOSTNAME_ANNOTATION: f"app{i}.example.com"
+                    },
+                ),
+            )
+        from agac_tpu.manager import ControllerConfig as CC
+
+        config = CC(
+            global_accelerator=GlobalAcceleratorConfig(
+                workers=3, drift_resync_period=DRIFT_PERIOD, queue_max_backoff=0.25
+            ),
+            route53=Route53Config(
+                workers=2, drift_resync_period=DRIFT_PERIOD, queue_max_backoff=0.25
+            ),
+            endpoint_group_binding=EndpointGroupBindingConfig(queue_max_backoff=0.25),
+        )
+        stop = start_manager(cluster, aws, config=config)
+        try:
+            owners = [f"service/default/svc{i}" for i in range(n)]
+
+            def all_converged():
+                if len(aws.all_accelerator_arns()) < n:
+                    return False
+                if not all(
+                    chain_complete(aws, owner, nlb_hostname(i))
+                    for i, owner in enumerate(owners)
+                ):
+                    return False
+                names = {(r.name, r.type) for r in aws.records_in_zone(zone.id)}
+                return all(
+                    (f"app{i}.example.com.", rtype) in names
+                    for i in range(n)
+                    for rtype in ("A", "TXT")
+                )
+
+            assert wait_until(all_converged, timeout=30.0), "initial convergence"
+
+            # the storm: 20 random out-of-band mutations, no k8s edits
+            for _ in range(20):
+                kind = rng.choice(["disable", "drop_eg", "drop_listener", "drop_records"])
+                arns = aws.all_accelerator_arns()
+                if kind == "disable" and arns:
+                    aws.update_accelerator(rng.choice(arns), enabled=False)
+                elif kind == "drop_eg":
+                    with aws._lock:
+                        eg_arns = list(aws._endpoint_groups)
+                    if eg_arns:
+                        aws.delete_endpoint_group(rng.choice(eg_arns))
+                elif kind == "drop_listener":
+                    with aws._lock:
+                        listener_arns = list(aws._listener_parent)
+                    if listener_arns:
+                        victim = rng.choice(listener_arns)
+                        with aws._lock:
+                            eg_victims = [
+                                eg for eg, parent in aws._eg_parent.items()
+                                if parent == victim
+                            ]
+                        for eg in eg_victims:
+                            aws.delete_endpoint_group(eg)
+                        aws.delete_listener(victim)
+                elif kind == "drop_records":
+                    records = aws.records_in_zone(zone.id)
+                    if records:
+                        victim = rng.choice(records)
+                        aws.change_resource_record_sets(
+                            zone.id, [Change("DELETE", victim)]
+                        )
+                time.sleep(rng.uniform(0, 0.05))
+
+            assert wait_until(all_converged, timeout=30.0), (
+                "drift resync did not repair the tamper storm"
+            )
+        finally:
+            stop.set()

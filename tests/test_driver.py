@@ -421,6 +421,71 @@ class TestRoute53:
         assert txt_values == {ours, theirs}
         assert ("app.example.com.", "A") in records
 
+    def test_repairs_stranded_own_alias_a(self, backend, driver, with_accelerator):
+        """The mirror-image strand: the ownership TXT was deleted
+        OUT-OF-BAND but our alias A survived.  A CREATE of the A would
+        fail the atomic batch with InvalidChangeBatch forever; the
+        ensure recognizes the A as its own (exact accelerator-DNS
+        alias target) and reclaims it with UPSERT.  Found by the
+        drift-resync tamper storm (tests/test_drift_resync.py); the
+        reference wedges identically here."""
+        from agac_tpu.cloudprovider.aws.types import Change
+
+        svc, arn, zone = with_accelerator
+        created, _ = driver.ensure_route53_for_service(
+            svc, svc.status.load_balancer.ingress[0], ["app.example.com"], "default"
+        )
+        assert created
+        txt = next(
+            r for r in backend.records_in_zone(zone.id) if r.type == "TXT"
+        )
+        backend.change_resource_record_sets(zone.id, [Change("DELETE", txt)])
+        created, retry = driver.ensure_route53_for_service(
+            svc, svc.status.load_balancer.ingress[0], ["app.example.com"], "default"
+        )
+        assert created and retry == 0
+        names = {(r.name, r.type) for r in backend.records_in_zone(zone.id)}
+        assert names == {("app.example.com.", "TXT"), ("app.example.com.", "A")}
+
+    def test_foreign_alias_a_fails_loudly(self, backend, driver, with_accelerator):
+        """An un-TXT'd A record aliasing some OTHER target must not be
+        reclaimed: the CREATE stays and fails (retried), exactly like
+        a foreign TXT."""
+        from agac_tpu.cloudprovider.aws.types import (
+            AliasTarget,
+            Change,
+            ResourceRecordSet,
+        )
+
+        svc, arn, zone = with_accelerator
+        backend.change_resource_record_sets(
+            zone.id,
+            [
+                Change(
+                    "CREATE",
+                    ResourceRecordSet(
+                        name="app.example.com",
+                        type="A",
+                        alias_target=AliasTarget(
+                            dns_name="somebody-elses-target.example.net.",
+                            evaluate_target_health=True,
+                            hosted_zone_id="Z2BJ6XQ5FK7U4H",
+                        ),
+                    ),
+                )
+            ],
+        )
+        with pytest.raises(AWSAPIError):
+            driver.ensure_route53_for_service(
+                svc, svc.status.load_balancer.ingress[0], ["app.example.com"], "default"
+            )
+        records = {(r.name, r.type): r for r in backend.records_in_zone(zone.id)}
+        # foreign A untouched, no ownership TXT snuck in
+        assert records[("app.example.com.", "A")].alias_target.dns_name == (
+            "somebody-elses-target.example.net."
+        )
+        assert ("app.example.com.", "TXT") not in records
+
     def test_foreign_txt_fails_loudly(self, backend, driver, with_accelerator):
         """A TXT at the hostname owned by someone else must NOT be
         clobbered — the ensure fails (and retries) like the reference's

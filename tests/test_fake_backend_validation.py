@@ -35,7 +35,7 @@ from agac_tpu.cloudprovider.aws.types import (
     Tag,
 )
 
-from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+from .fixtures import NLB_REGION, make_lb_service
 
 
 @pytest.fixture

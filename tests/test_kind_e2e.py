@@ -36,7 +36,6 @@ inside the kind node and asserts the informer recovers with no drift
 
 from __future__ import annotations
 
-import json
 import os
 import pathlib
 import subprocess

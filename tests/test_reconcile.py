@@ -12,7 +12,6 @@ reference's ``pkg/reconcile/reconcile.go:59-90`` (SURVEY.md §7 stage 1):
 | Result()                        | forget                             |
 """
 
-import copy
 import dataclasses
 
 import pytest
