@@ -34,7 +34,10 @@ from typing import Optional
 from .. import klog
 from ..apis.endpointgroupbinding import FINALIZER, EndpointGroupBinding
 from ..cloudprovider.aws import aws_error_code, get_region_from_arn
-from ..cloudprovider.aws.errors import ERR_ENDPOINT_GROUP_NOT_FOUND
+from ..cloudprovider.aws.errors import (
+    ERR_ENDPOINT_GROUP_NOT_FOUND,
+    EndpointGroupNotFoundException,
+)
 from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
 from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
@@ -224,14 +227,64 @@ class EndpointGroupBindingController:
         ]
         klog.v(4).infof("New EndpointIds: %r", new_endpoint_ids)
         klog.v(4).infof("Removed EndpointIds: %r", removed_endpoint_ids)
+        endpoint_group = None
         if (
             not new_endpoint_ids
             and not removed_endpoint_ids
             and obj.status.observed_generation == obj.metadata.generation
         ):
-            return Result()
+            # the reference returns here unconditionally
+            # (``reconcile.go:157-159``) — status is trusted, so AWS
+            # state mutated out-of-band is never re-examined.  With
+            # drift resync on, that would make the ticker a no-op for
+            # converged bindings: verify the ACTUAL endpoint group
+            # instead (one describe per tick, reused below when drift
+            # is found) and fall through to the repair path when an
+            # endpoint vanished or a weight was edited behind the
+            # controller.
+            if self._drift_resync_period <= 0:
+                return Result()
+            try:
+                endpoint_group = cloud.describe_endpoint_group(
+                    obj.spec.endpoint_group_arn
+                )
+            except EndpointGroupNotFoundException:
+                # the whole group was deleted out-of-band: the ARN is
+                # immutable, so no retry can ever succeed — surface it
+                # and stop (the delete path tolerates the same code,
+                # and deleting the binding remains the way out)
+                self.recorder.eventf(
+                    obj, "Warning", "EndpointGroupGone",
+                    "endpoint group %s no longer exists; delete or recreate "
+                    "this EndpointGroupBinding",
+                    obj.spec.endpoint_group_arn,
+                )
+                return Result()
+            present = {
+                d.endpoint_id: d for d in endpoint_group.endpoint_descriptions
+            }
+            # the guard above means every status id is a key of arns,
+            # so membership drift reduces to "status id absent in AWS"
+            missing = [
+                endpoint_id
+                for endpoint_id in obj.status.endpoint_ids
+                if endpoint_id not in present
+            ]
+            weight_drifted = obj.spec.weight is not None and any(
+                present[endpoint_id].weight != obj.spec.weight
+                for endpoint_id in arns
+                if endpoint_id in present
+            )
+            if not missing and not weight_drifted:
+                return Result()
+            klog.infof(
+                "Drift on EndpointGroupBinding %s/%s: missing=%r weight_drifted=%s",
+                obj.metadata.namespace, obj.metadata.name, missing, weight_drifted,
+            )
+            new_endpoint_ids = missing  # re-add through the normal path
 
-        endpoint_group = cloud.describe_endpoint_group(obj.spec.endpoint_group_arn)
+        if endpoint_group is None:
+            endpoint_group = cloud.describe_endpoint_group(obj.spec.endpoint_group_arn)
 
         results = list(obj.status.endpoint_ids)
         for endpoint_id in removed_endpoint_ids:
@@ -250,7 +303,9 @@ class EndpointGroupBindingController:
             )
             if retry_after > 0:
                 return Result(requeue=True, requeue_after=retry_after)
-            if added_id is not None:
+            if added_id is not None and added_id not in results:
+                # drift repair re-adds ids that are still in status —
+                # appending unconditionally would duplicate them
                 results.append(added_id)
 
         # weight sync for every bound endpoint (reference ``reconcile.go:195-202``)

@@ -28,7 +28,7 @@ from agac_tpu.controllers import (
 )
 from agac_tpu.manager import ControllerConfig
 from agac_tpu.controllers.common import start_drift_resync
-from agac_tpu.cluster import FakeCluster
+from agac_tpu.cluster import FakeCluster, ObjectMeta
 from agac_tpu.manager import Manager
 
 from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
@@ -330,41 +330,241 @@ class TestTamperStorm:
 
             assert wait_until(all_converged, timeout=30.0), "initial convergence"
 
-            # the storm: 20 random out-of-band mutations, no k8s edits
+            # the storm: 20 random out-of-band mutations, no k8s edits.
+            # Each op is best-effort: the RUNNING controllers race the
+            # tamperer (a drift tick can recreate an endpoint group
+            # between our EG delete and listener delete, or delete a
+            # record we were about to), and a tamperer losing such a
+            # race is itself realistic — skip and keep storming.
+            from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+
             for _ in range(20):
                 kind = rng.choice(["disable", "drop_eg", "drop_listener", "drop_records"])
-                arns = aws.all_accelerator_arns()
-                if kind == "disable" and arns:
-                    aws.update_accelerator(rng.choice(arns), enabled=False)
-                elif kind == "drop_eg":
-                    with aws._lock:
-                        eg_arns = list(aws._endpoint_groups)
-                    if eg_arns:
-                        aws.delete_endpoint_group(rng.choice(eg_arns))
-                elif kind == "drop_listener":
-                    with aws._lock:
-                        listener_arns = list(aws._listener_parent)
-                    if listener_arns:
-                        victim = rng.choice(listener_arns)
+                try:
+                    arns = aws.all_accelerator_arns()
+                    if kind == "disable" and arns:
+                        aws.update_accelerator(rng.choice(arns), enabled=False)
+                    elif kind == "drop_eg":
                         with aws._lock:
-                            eg_victims = [
-                                eg for eg, parent in aws._eg_parent.items()
-                                if parent == victim
-                            ]
-                        for eg in eg_victims:
-                            aws.delete_endpoint_group(eg)
-                        aws.delete_listener(victim)
-                elif kind == "drop_records":
-                    records = aws.records_in_zone(zone.id)
-                    if records:
-                        victim = rng.choice(records)
-                        aws.change_resource_record_sets(
-                            zone.id, [Change("DELETE", victim)]
-                        )
+                            eg_arns = list(aws._endpoint_groups)
+                        if eg_arns:
+                            aws.delete_endpoint_group(rng.choice(eg_arns))
+                    elif kind == "drop_listener":
+                        with aws._lock:
+                            listener_arns = list(aws._listener_parent)
+                        if listener_arns:
+                            victim = rng.choice(listener_arns)
+                            with aws._lock:
+                                eg_victims = [
+                                    eg for eg, parent in aws._eg_parent.items()
+                                    if parent == victim
+                                ]
+                            for eg in eg_victims:
+                                aws.delete_endpoint_group(eg)
+                            aws.delete_listener(victim)
+                    elif kind == "drop_records":
+                        records = aws.records_in_zone(zone.id)
+                        if records:
+                            victim = rng.choice(records)
+                            aws.change_resource_record_sets(
+                                zone.id, [Change("DELETE", victim)]
+                            )
+                except AWSAPIError:
+                    pass  # lost the race to a controller worker
                 time.sleep(rng.uniform(0, 0.05))
 
             assert wait_until(all_converged, timeout=30.0), (
                 "drift resync did not repair the tamper storm"
+            )
+        finally:
+            stop.set()
+
+
+class TestEndpointGroupBindingDrift:
+    """With drift resync on, the EGB reconcile verifies the ACTUAL
+    endpoint group instead of trusting status (the reference's guard,
+    ``reconcile.go:157-159``, returns early and would make the ticker
+    a no-op): an endpoint removed out-of-band is re-added and an
+    edited weight is restored.  At the default period 0 the guard is
+    exact reference behavior — zero AWS calls for converged bindings."""
+
+    BOUND_HOST = "bound-0123456789abcdef.elb.us-west-2.amazonaws.com"
+
+    def setup_bound_fleet(self, aws, cluster):
+        from agac_tpu.apis.endpointgroupbinding.v1alpha1 import (
+            EndpointGroupBinding,
+            EndpointGroupBindingSpec,
+            ServiceReference,
+        )
+        from .fixtures import NLB_NAME
+
+        driver = AWSDriver(aws, aws, aws)
+        seed_svc = make_lb_service()
+        arn, _, _ = driver.ensure_global_accelerator_for_service(
+            seed_svc, seed_svc.status.load_balancer.ingress[0],
+            "other", NLB_NAME, NLB_REGION,
+        )
+        endpoint_group = driver.get_endpoint_group(driver.get_listener(arn).listener_arn)
+        aws.add_load_balancer("bound", NLB_REGION, self.BOUND_HOST)
+        cluster.create(
+            "Service", make_lb_service(name="bound", hostname=self.BOUND_HOST)
+        )
+        binding = EndpointGroupBinding(
+            metadata=ObjectMeta(name="binding", namespace="default"),
+            spec=EndpointGroupBindingSpec(
+                endpoint_group_arn=endpoint_group.endpoint_group_arn,
+                weight=100,
+                service_ref=ServiceReference(name="bound"),
+            ),
+        )
+        cluster.create("EndpointGroupBinding", binding)
+        return endpoint_group
+
+    def run_binding_manager(self, aws, cluster, drift_period):
+        stop = threading.Event()
+        config = ControllerConfig(
+            global_accelerator=GlobalAcceleratorConfig(workers=1),
+            route53=Route53Config(workers=1),
+            endpoint_group_binding=EndpointGroupBindingConfig(
+                workers=1, drift_resync_period=drift_period
+            ),
+        )
+        Manager(resync_period=300).run(
+            cluster, config, stop,
+            cloud_factory=lambda region: AWSDriver(aws, aws, aws),
+            block=False,
+        )
+        return stop
+
+    def bound_weight(self, aws, endpoint_group, endpoint_id):
+        described = aws.describe_endpoint_group(endpoint_group.endpoint_group_arn)
+        for d in described.endpoint_descriptions:
+            if d.endpoint_id == endpoint_id:
+                return d.weight
+        return None
+
+    def test_weight_edit_and_endpoint_removal_repaired(self):
+        aws = FakeAWSBackend()
+        aws.add_load_balancer(
+            "testlb", NLB_REGION,
+            "testlb-0123456789abcdef.elb.us-west-2.amazonaws.com",
+        )
+        cluster = FakeCluster()
+        endpoint_group = self.setup_bound_fleet(aws, cluster)
+        stop = self.run_binding_manager(aws, cluster, DRIFT_PERIOD)
+        try:
+            def bound_id():
+                obj = cluster.get("EndpointGroupBinding", "default", "binding")
+                return obj.status.endpoint_ids[0] if obj.status.endpoint_ids else None
+
+            wait_until(lambda: bound_id() is not None, message="binding")
+            endpoint_id = bound_id()
+            wait_until(
+                lambda: self.bound_weight(aws, endpoint_group, endpoint_id) == 100,
+                message="initial weight",
+            )
+            # out-of-band: someone edits the weight in the console
+            described = aws.describe_endpoint_group(endpoint_group.endpoint_group_arn)
+            from agac_tpu.cloudprovider.aws.types import EndpointConfiguration
+
+            aws.update_endpoint_group(
+                endpoint_group.endpoint_group_arn,
+                [
+                    EndpointConfiguration(
+                        endpoint_id=d.endpoint_id,
+                        weight=7 if d.endpoint_id == endpoint_id else d.weight,
+                        client_ip_preservation_enabled=d.client_ip_preservation_enabled,
+                    )
+                    for d in described.endpoint_descriptions
+                ],
+            )
+            wait_until(
+                lambda: self.bound_weight(aws, endpoint_group, endpoint_id) == 100,
+                message="drift resync to restore the weight",
+            )
+            # out-of-band: the bound endpoint is removed entirely
+            aws.remove_endpoints(endpoint_group.endpoint_group_arn, [endpoint_id])
+            wait_until(
+                lambda: self.bound_weight(aws, endpoint_group, endpoint_id) == 100,
+                message="drift resync to re-add the endpoint",
+            )
+            # status must not have accumulated duplicates across repairs
+            obj = cluster.get("EndpointGroupBinding", "default", "binding")
+            assert obj.status.endpoint_ids.count(endpoint_id) == 1
+        finally:
+            stop.set()
+
+    def test_default_zero_keeps_reference_guard(self):
+        """Period 0: the converged-binding early return stays exact
+        reference behavior — drift is NOT examined (and costs zero
+        AWS calls)."""
+        aws = FakeAWSBackend()
+        aws.add_load_balancer(
+            "testlb", NLB_REGION,
+            "testlb-0123456789abcdef.elb.us-west-2.amazonaws.com",
+        )
+        cluster = FakeCluster()
+        endpoint_group = self.setup_bound_fleet(aws, cluster)
+        stop = self.run_binding_manager(aws, cluster, drift_period=0.0)
+        try:
+            def bound_id():
+                obj = cluster.get("EndpointGroupBinding", "default", "binding")
+                return obj.status.endpoint_ids[0] if obj.status.endpoint_ids else None
+
+            wait_until(lambda: bound_id() is not None, message="binding")
+            endpoint_id = bound_id()
+            wait_until(
+                lambda: self.bound_weight(aws, endpoint_group, endpoint_id) == 100,
+                message="initial weight",
+            )
+            aws.remove_endpoints(endpoint_group.endpoint_group_arn, [endpoint_id])
+            time.sleep(0.8)
+            assert self.bound_weight(aws, endpoint_group, endpoint_id) is None
+        finally:
+            stop.set()
+
+    def test_deleted_endpoint_group_warns_instead_of_error_looping(self):
+        """The whole endpoint group deleted out-of-band: the ARN is
+        immutable, so no retry can succeed — the drift tick emits an
+        EndpointGroupGone Warning and returns instead of throwing on
+        every tick forever."""
+        aws = FakeAWSBackend()
+        aws.add_load_balancer(
+            "testlb", NLB_REGION,
+            "testlb-0123456789abcdef.elb.us-west-2.amazonaws.com",
+        )
+        cluster = FakeCluster()
+        endpoint_group = self.setup_bound_fleet(aws, cluster)
+        stop = self.run_binding_manager(aws, cluster, DRIFT_PERIOD)
+        try:
+            def bound_id():
+                obj = cluster.get("EndpointGroupBinding", "default", "binding")
+                return obj.status.endpoint_ids[0] if obj.status.endpoint_ids else None
+
+            wait_until(lambda: bound_id() is not None, message="binding")
+            # out-of-band: the whole group (and its endpoints) vanish
+            aws.remove_endpoints(
+                endpoint_group.endpoint_group_arn,
+                [
+                    d.endpoint_id
+                    for d in aws.describe_endpoint_group(
+                        endpoint_group.endpoint_group_arn
+                    ).endpoint_descriptions
+                ],
+            )
+            aws.delete_endpoint_group(endpoint_group.endpoint_group_arn)
+
+            def gone_event_emitted():
+                return any(
+                    e.reason == "EndpointGroupGone"
+                    for e in cluster.list("Event")[0]
+                )
+
+            wait_until(gone_event_emitted, message="EndpointGroupGone Warning")
+            # and the binding did NOT enter a failure streak: no
+            # SyncFailing warner events from repeated exceptions
+            assert not any(
+                e.reason == "SyncFailing" for e in cluster.list("Event")[0]
             )
         finally:
             stop.set()
