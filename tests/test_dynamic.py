@@ -307,3 +307,52 @@ def test_cluster_scoped_set_stays_within_known_kinds():
         "ValidatingWebhookConfiguration",
     ):
         assert kind in CLUSTER_SCOPED_KINDS
+
+
+class TestApplyConflictConcurrency:
+    def test_concurrent_non_force_applies_exactly_one_winner(self, ssa_server):
+        """The conflict adjudication is atomic under ThreadingHTTPServer
+        (server-level apply lock): N managers racing non-force applies
+        of the same field produce exactly one owner and N-1 409s —
+        never a silent last-writer-wins."""
+        import threading
+
+        n = 6
+        barrier = threading.Barrier(n)
+        outcomes = []
+        outcome_lock = threading.Lock()
+
+        def racer(i):
+            dyn = DynamicClient(RestClusterClient(ssa_server.url))
+            barrier.wait()
+            try:
+                dyn.apply(
+                    service_manifest(port=1000 + i),
+                    field_manager=f"racer-{i}",
+                    force=False,
+                )
+                result = ("won", i)
+            except DynamicApplyError as err:
+                result = ("conflict", i) if err.status == 409 else ("error", err.status)
+            with outcome_lock:
+                outcomes.append(result)
+
+        threads = [threading.Thread(target=racer, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+            assert not t.is_alive(), "racer wedged past its 10s budget"
+        wins = [o for o in outcomes if o[0] == "won"]
+        conflicts = [o for o in outcomes if o[0] == "conflict"]
+        assert len(wins) == 1, outcomes
+        assert len(conflicts) == n - 1, outcomes
+        # the recorded manager is the single winner
+        winner = f"racer-{wins[0][1]}"
+        assert (
+            ssa_server.apply_managers[("Service", "default", "dyn-svc")] == winner
+        )
+        port = DynamicClient(RestClusterClient(ssa_server.url)).get(
+            service_manifest()
+        )["spec"]["ports"][0]["port"]
+        assert port == 1000 + wins[0][1]
