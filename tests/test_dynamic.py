@@ -334,6 +334,8 @@ class TestApplyConflictConcurrency:
                 result = ("won", i)
             except DynamicApplyError as err:
                 result = ("conflict", i) if err.status == 409 else ("error", err.status)
+            except Exception as err:  # transport-level: record, don't vanish
+                result = ("exception", repr(err))
             with outcome_lock:
                 outcomes.append(result)
 
