@@ -189,11 +189,26 @@ class TestCleanShutdown:
         leaked threads would accumulate until OOM."""
         import threading as threading_mod
 
+        from agac_tpu.controllers import (
+            EndpointGroupBindingConfig,
+            GlobalAcceleratorConfig,
+            Route53Config,
+        )
+
         baseline = threading_mod.active_count()
+        # drift resync ON so the ticker threads (one per controller)
+        # are part of what each generation must tear down
+        drift_config = ControllerConfig(
+            global_accelerator=GlobalAcceleratorConfig(drift_resync_period=0.1),
+            route53=Route53Config(drift_resync_period=0.1),
+            endpoint_group_binding=EndpointGroupBindingConfig(
+                drift_resync_period=0.1
+            ),
+        )
         for _ in range(3):
             cluster, aws = FakeCluster(), FakeAWSBackend()
             aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
-            stop = start_manager(cluster, aws)
+            stop = start_manager(cluster, aws, config=drift_config)
             cluster.create("Service", make_lb_service())
             assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
             stop.set()
