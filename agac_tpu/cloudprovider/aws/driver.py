@@ -881,20 +881,7 @@ class AWSDriver:
         owner_value = Route53OwnerValue(cluster_name, resource, ns, name)
         created = False
         for hostname in hostnames:
-            try:
-                created |= self._ensure_route53_hostname(
-                    hostname, owner_value, accelerator
-                )
-            except AWSAPIError as err:
-                if (
-                    err.code == "NoSuchHostedZone"
-                    and self._zone_cache is not None
-                ):
-                    # a snapshot zone was deleted out-of-band: drop the
-                    # snapshot so the retry re-reads instead of failing
-                    # for the rest of the TTL
-                    self._zone_cache.invalidate()
-                raise
+            created |= self._ensure_route53_hostname(hostname, owner_value, accelerator)
 
         klog.infof("All records are synced for %s %s/%s", resource, ns, name)
         return created, 0.0
@@ -904,6 +891,27 @@ class AWSDriver:
     ) -> bool:
         """Ensure the TXT+A pair for ONE hostname; True if created."""
         hosted_zone = self.get_hosted_zone(hostname)
+        try:
+            return self._ensure_route53_in_zone(
+                hosted_zone, hostname, owner_value, accelerator
+            )
+        except AWSAPIError as err:
+            if err.code == "NoSuchHostedZone" and self._zone_cache is not None:
+                # the zone we RESOLVED vanished mid-ensure (deleted
+                # out-of-band): drop the snapshot so the retry
+                # re-reads.  Scoped here, after resolution succeeded,
+                # on purpose — when get_hosted_zone itself raises (a
+                # hostname matching no zone at all) the live walk was
+                # already the source of truth and the snapshot is not
+                # at fault, so a persistently misconfigured object
+                # must not flush the warm snapshot on every backoff
+                # retry.
+                self._zone_cache.invalidate()
+            raise
+
+    def _ensure_route53_in_zone(
+        self, hosted_zone, hostname: str, owner_value: str, accelerator: Accelerator
+    ) -> bool:
         klog.infof("HostedZone is %s", hosted_zone.id)
         klog.infof(
             "Finding record sets %r for HostedZone %s", owner_value, hosted_zone.id

@@ -119,9 +119,7 @@ class Route53Controller:
     # event handlers (reference ``route53/controller.go:89-170``)
     # ------------------------------------------------------------------
     def _add_service_notification(self, svc) -> None:
-        if was_load_balancer_service(svc) and has_annotation(
-            svc, apis.ROUTE53_HOSTNAME_ANNOTATION
-        ):
+        if is_hostname_managed_service(svc):
             self._enqueue(self.service_queue, svc)
 
     def _update_service_notification(self, old, new) -> None:
@@ -143,7 +141,7 @@ class Route53Controller:
     def _add_ingress_notification(self, ingress) -> None:
         # the reference gates ingress adds on the hostname annotation
         # only, not the ALB predicate (``route53/controller.go:131-136``)
-        if has_annotation(ingress, apis.ROUTE53_HOSTNAME_ANNOTATION):
+        if is_hostname_managed_ingress(ingress):
             self._enqueue(self.ingress_queue, ingress)
 
     def _update_ingress_notification(self, old, new) -> None:
@@ -163,6 +161,24 @@ class Route53Controller:
     @staticmethod
     def _enqueue(queue: RateLimitingQueue, obj) -> None:
         queue.add_rate_limited(meta_namespace_key(obj))
+
+    def drift_resync_sources(self) -> list:
+        """The canonical ``[(lister, predicate, enqueue), ...]`` drift
+        re-enqueue wiring — consumed by the in-process ticker and by
+        external single-tick drivers (the bench's drift-tick
+        measurement), so the two can never diverge."""
+        return [
+            (
+                self.service_lister,
+                is_hostname_managed_service,
+                lambda svc: self.service_queue.add(meta_namespace_key(svc)),
+            ),
+            (
+                self.ingress_lister,
+                is_hostname_managed_ingress,
+                lambda ing: self.ingress_queue.add(meta_namespace_key(ing)),
+            ),
+        ]
 
     # ------------------------------------------------------------------
     # run loop
@@ -198,18 +214,7 @@ class Route53Controller:
         # GlobalAccelerator controller's resync comment
         start_drift_resync(
             CONTROLLER_AGENT_NAME, stop, self._drift_resync_period,
-            [
-                (
-                    self.service_lister,
-                    is_hostname_managed_service,
-                    lambda svc: self.service_queue.add(meta_namespace_key(svc)),
-                ),
-                (
-                    self.ingress_lister,
-                    is_hostname_managed_ingress,
-                    lambda ing: self.ingress_queue.add(meta_namespace_key(ing)),
-                ),
-            ],
+            self.drift_resync_sources(),
         )
         stop.wait()
         klog.info("Shutting down workers")

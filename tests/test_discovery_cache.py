@@ -375,3 +375,65 @@ class TestHostedZoneCache:
         misses_before = cache.misses
         driver.cleanup_record_set("default", "service", "default", "web")
         assert cache.misses == misses_before + 1
+
+    def test_misconfigured_hostname_keeps_snapshot_warm(self, backend):
+        """A Service whose route53-hostname annotation matches NO
+        hosted zone fails its ensure with NoSuchHostedZone — raised by
+        get_hosted_zone's live-walk fallback, the source of truth, so
+        the snapshot is NOT at fault and must survive: a persistently
+        misconfigured object retrying on backoff must not force a full
+        ListHostedZones reload for every other ensure (r4 advisor)."""
+        from agac_tpu.cloudprovider.aws.cache import HostedZoneCache
+        from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+
+        backend.add_hosted_zone("example.com")
+        cache = HostedZoneCache(ttl=600.0)
+        driver = make_driver(backend, None)
+        driver._zone_cache = cache
+        svc = make_lb_service()
+        ensure(driver, svc)  # the accelerator the ensure aliases
+        driver.get_hosted_zone("www.example.com")  # warm the snapshot
+        misses_before = cache.misses
+        for _ in range(3):  # every backoff retry of the bad object
+            with pytest.raises(AWSAPIError, match="NoSuchHostedZone"):
+                driver.ensure_route53_for_service(
+                    svc,
+                    svc.status.load_balancer.ingress[0],
+                    ["app.unrelated.org"],
+                    "default",
+                )
+        # the warm snapshot survived: other ensures keep hitting it
+        driver.get_hosted_zone("www.example.com")
+        assert cache.misses == misses_before
+
+    def test_ensure_invalidates_when_resolved_zone_vanishes(self, backend):
+        """The counterpart: a zone that RESOLVED (from the snapshot)
+        and then vanished out-of-band mid-ensure must still drop the
+        snapshot so the retry re-reads."""
+        from agac_tpu.cloudprovider.aws.cache import HostedZoneCache
+        from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+
+        zone = backend.add_hosted_zone("example.com")
+        cache = HostedZoneCache(ttl=600.0)
+        driver = make_driver(backend, None)
+        driver._zone_cache = cache
+        svc = make_lb_service()
+        ensure(driver, svc)
+        driver.get_hosted_zone("www.example.com")  # warm the snapshot
+        # out-of-band: the zone disappears behind the controller
+        with backend._lock:
+            del backend._zones[zone.id]
+            del backend._records[zone.id]
+        misses_before = cache.misses
+        with pytest.raises(AWSAPIError, match="NoSuchHostedZone"):
+            driver.ensure_route53_for_service(
+                svc,
+                svc.status.load_balancer.ingress[0],
+                ["app.example.com"],
+                "default",
+            )
+        # the failure dropped the snapshot: the next resolution
+        # reloads (and correctly fails to find the deleted zone)
+        with pytest.raises(AWSAPIError, match="NoSuchHostedZone"):
+            driver.get_hosted_zone("www.example.com")
+        assert cache.misses == misses_before + 1
