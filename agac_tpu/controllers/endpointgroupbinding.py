@@ -107,6 +107,20 @@ class EndpointGroupBindingController:
     def _enqueue(self, obj) -> None:
         self.workqueue.add_rate_limited(meta_namespace_key(obj))
 
+    def drift_resync_sources(self) -> list:
+        """The canonical ``[(lister, predicate, enqueue), ...]`` drift
+        re-enqueue wiring — consumed by the in-process ticker and by
+        external single-tick drivers (the bench's drift-tick
+        measurement), so the two can never diverge."""
+        # every EndpointGroupBinding is managed (no annotation gate)
+        return [
+            (
+                self.binding_lister,
+                lambda b: True,
+                lambda b: self.workqueue.add(meta_namespace_key(b)),
+            )
+        ]
+
     # ------------------------------------------------------------------
     # run loop (reference ``controller.go:103-141``)
     # ------------------------------------------------------------------
@@ -131,9 +145,7 @@ class EndpointGroupBindingController:
         # GlobalAccelerator controller's resync comment
         start_drift_resync(
             CONTROLLER_AGENT_NAME, stop, self._drift_resync_period,
-            # every EndpointGroupBinding is managed (no annotation gate)
-            [(self.binding_lister, lambda b: True,
-              lambda b: self.workqueue.add(meta_namespace_key(b)))],
+            self.drift_resync_sources(),
         )
         stop.wait()
         klog.info("Shutting down workers")

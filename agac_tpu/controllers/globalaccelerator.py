@@ -231,24 +231,32 @@ class GlobalAcceleratorController:
         # mid-retry-backoff.
         start_drift_resync(
             CONTROLLER_AGENT_NAME, stop, self._drift_resync_period,
-            [
-                (
-                    self.service_lister,
-                    is_managed_service,
-                    lambda svc: self.service_queue.add(meta_namespace_key(svc)),
-                ),
-                (
-                    self.ingress_lister,
-                    is_managed_ingress,
-                    lambda ing: self.ingress_queue.add(meta_namespace_key(ing)),
-                ),
-            ],
+            self.drift_resync_sources(),
         )
         stop.wait()
         klog.info("Shutting down workers")
         self.service_queue.shutdown()
         self.ingress_queue.shutdown()
         self.recorder.shutdown()
+
+    def drift_resync_sources(self) -> list:
+        """The canonical ``[(lister, predicate, enqueue), ...]`` drift
+        re-enqueue wiring — consumed by the in-process ticker
+        (``start_drift_resync``) and by external single-tick drivers
+        (the bench's drift-tick measurement), so the two can never
+        diverge."""
+        return [
+            (
+                self.service_lister,
+                is_managed_service,
+                lambda svc: self.service_queue.add(meta_namespace_key(svc)),
+            ),
+            (
+                self.ingress_lister,
+                is_managed_ingress,
+                lambda ing: self.ingress_queue.add(meta_namespace_key(ing)),
+            ),
+        ]
 
     def _key_to_service(self, key: str):
         ns, name = split_meta_namespace_key(key)

@@ -3,67 +3,100 @@
 The reference publishes no benchmark numbers (BASELINE.md: no
 ``benchmarks/`` dir, no ``Benchmark*`` funcs, no perf claims), so this
 measures the framework's own headline capability — full watch →
-informer → queue → reconcile → cloud-ensure convergence of the
-GlobalAccelerator AND Route53 controllers together — and reports
-``vs_baseline`` against the reference's implicit operating point
-(1 worker per queue, ``cmd/controller/controller.go:32``; client-go's
-fixed 10 qps / 100 burst enqueue bucket; the O(N)+1 ListTags discovery
-scan on every reconcile, ``global_accelerator.go:87-110``).
+informer → queue → reconcile → cloud-ensure convergence of ALL THREE
+controllers together — and reports ``vs_baseline`` against the
+reference's implicit operating point (1 worker per queue,
+``cmd/controller/controller.go:32``; client-go's fixed 10 qps / 100
+burst enqueue bucket; the O(N)+1 ListTags discovery scan on every
+reconcile, ``global_accelerator.go:87-110``).
+
+The workload drives every controller and every API family:
+
+- N annotated ``Service``s — each needs an accelerator + listener +
+  endpoint-group chain AND an atomic TXT+A Route53 record pair
+  (hostnames spread over 10 hosted zones).
+- N/10 annotated ALB ``Ingress``es — exercising the listen-ports
+  listener derivation (half carry the
+  ``alb.ingress.kubernetes.io/listen-ports`` JSON annotation, half
+  derive ports from rule backends — reference
+  ``global_accelerator.go:517-552``) plus their own Route53 pairs.
+- N/10 ``EndpointGroupBinding``s bound into pre-existing endpoint
+  groups (reference ``pkg/controller/endpointgroupbinding/
+  reconcile.go:112-217``), with a post-convergence CHURN phase:
+  every binding's weight is edited and every binding with a same-
+  namespace partner Service swaps its serviceRef — endpoint
+  add/remove/weight-sync under load.
 
 The fake cloud is SHAPED, not uniform:
 
 - **Asymmetric per-operation latency.**  Every operation of all three
-  API families (GlobalAccelerator, ELBv2, Route53 — endpoint-group and
-  record-change ops included) sleeps a per-op latency taken from
-  real-world control-plane behavior (CreateAccelerator is the slowest
-  by an order of magnitude; List*/Describe* are fast).  Latencies are
+  API families (GlobalAccelerator, ELBv2, Route53) sleeps a per-op
+  latency taken from real-world control-plane behavior.  Latencies are
   scaled to 1/10 of their real-world values so the bench completes in
   minutes; quotas are scaled x10 to match, so the RELATIVE pressure
-  (which API binds, how much concurrency pays) is preserved under the
-  time compression.
+  (which API binds, how much concurrency pays) is preserved.
 - **Per-API throttle quotas.**  Each API family has a token bucket
   (GA mutate, GA read, ELBv2, Route53).  A call that finds the bucket
   empty BLOCKS until admitted — modeling an SDK in standard-retry mode
-  pacing itself under ThrottlingException rather than surfacing the
-  throttle to the application (our production client does the same:
-  ``real_backend.py`` standard retry mode).  The Route53 quota is
-  AWS's documented 5 req/s (x10 scale).
+  pacing itself under ThrottlingException (our production client does
+  the same: ``real_backend.py`` standard retry mode).
 
-The workload drives every family: each Service carries both the
-GA-managed annotation and a ``route53-hostname`` annotation resolving
-into one of 10 hosted zones, so convergence requires N accelerator
-chains (accelerator + listener + endpoint group) AND 2N Route53
-records (atomic TXT+A pair per service).
+The baseline is measured at N_BASELINE=100 (with its own /10 Ingress +
+EndpointGroupBinding populations) because the reference operating
+point's O(N) tag-scan per reconcile makes serial convergence at N=1000
+intractable (hours).  Comparing per-object rates FAVORS the baseline:
+its rate degrades superlinearly with N, so vs_baseline understates the
+gap at N=1000.
 
-The baseline is measured at N_BASELINE=100 services because the
-reference operating point's O(N) tag-scan per reconcile makes serial
-convergence at N=1000 intractable (hours).  Comparing per-service
-rates FAVORS the baseline: its rate degrades superlinearly with N, so
-vs_baseline understates the gap at N=1000.
+A separate DRIFT-TICK phase measures the cost of one
+``--drift-resync-period`` tick over a converged fleet: the fleet is
+converged with shaping disabled (fast), then one full ticker round is
+isolated by call-count quiescence and its per-op AWS call counts are
+recorded.  Tick wall-clock is derived from the same quota model the
+shaped phases use (calls_per_family / family_rate after burst) — see
+docs/operations.md "Drift resync at scale".
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"detail"} where detail carries per-controller p50/p99 per-item
-reconcile latency (via the reconcile loop's sync-duration observer
-seam), the steady-state AWS-call rate measured over one full 30 s
-resync cycle after convergence, per-op AWS call counts, and the
-latency/quota model itself so movement is auditable.
+Output contract (VERDICT r4 #1): the FINAL stdout line is ONE compact
+JSON object (< 1 KB) carrying metric/value/unit/vs_baseline plus key
+scalars; the full detail blob is written to ``bench_detail.json`` next
+to this file (committed, refreshed by each run) and the same path is
+named in the compact line.  Progress goes to stderr only.
 """
 
 import json
 import os
+import sys
 import threading
 import time
 
 from agac_tpu import klog
 from agac_tpu.cloudprovider.aws.cache import DiscoveryCache, HostedZoneCache
 from agac_tpu.apis import (
+    ALB_LISTEN_PORTS_ANNOTATION,
     AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
     AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    INGRESS_CLASS_ANNOTATION,
     ROUTE53_HOSTNAME_ANNOTATION,
+)
+from agac_tpu.apis.endpointgroupbinding import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
 )
 from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
 from agac_tpu.cluster import FakeCluster, LoadBalancerIngress, ObjectMeta, Service, ServicePort
-from agac_tpu.cluster.objects import ServiceSpec
+from agac_tpu.cluster.objects import (
+    HTTPIngressPath,
+    HTTPIngressRuleValue,
+    Ingress,
+    IngressBackend,
+    IngressLoadBalancerIngress,
+    IngressRule,
+    IngressServiceBackend,
+    IngressSpec,
+    ServiceBackendPort,
+    ServiceSpec,
+)
 from agac_tpu.manager import ControllerConfig, Manager
 from agac_tpu.reconcile import (
     BucketRateLimiter,
@@ -79,10 +112,27 @@ from agac_tpu.controllers import (
 N_SERVICES = int(os.environ.get("AGAC_BENCH_N", "1000"))
 N_BASELINE = int(os.environ.get("AGAC_BENCH_N_BASELINE", "100"))
 N_ZONES = 10
-TUNED_WORKERS = int(os.environ.get("AGAC_BENCH_WORKERS", "32"))
+# 16: the top of the band docs/operations.md "Sizing the worker pool"
+# recommends (8-16) — the headline config IS the documented config
+# (VERDICT r4 #7); throughput is GA-mutate-quota-bound from 8 workers
+# up, so 32 only bought ~6% at ~8x the GA p99
+TUNED_WORKERS = int(os.environ.get("AGAC_BENCH_WORKERS", "16"))
 RESYNC_PERIOD = 30.0  # the reference's informer resync default
-STEADY_WINDOW = RESYNC_PERIOD  # one full resync cycle
+# one full resync cycle; env-shrinkable so the output-contract smoke
+# test (tests/test_bench_output.py) completes in seconds
+STEADY_WINDOW = float(os.environ.get("AGAC_BENCH_STEADY_WINDOW", str(RESYNC_PERIOD)))
+# drift-tick phase fleet size (shaping disabled there, so N=1000
+# converges in seconds; the smoke test shrinks it)
+DRIFT_N = int(os.environ.get("AGAC_BENCH_DRIFT_N", str(N_SERVICES)))
 DEADLINE = 900.0
+
+# the committed full-scale detail artifact; overridable so the smoke
+# test (tests/test_bench_output.py) writes its tiny-fleet blob to a
+# tmp dir instead of clobbering the real record
+DETAIL_PATH = os.environ.get(
+    "AGAC_BENCH_DETAIL_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_detail.json"),
+)
 
 # Time compression: real-world latencies / LATENCY_SCALE, quotas
 # x LATENCY_SCALE — same shape, 1/10 the wall clock.
@@ -162,6 +212,10 @@ OP_FAMILY = {
 }
 
 
+def _progress(msg: str) -> None:
+    print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
 class TokenBucket:
     """Blocking facade over the framework's own ``BucketRateLimiter``
     (one canonical token-bucket implementation): ``acquire`` reserves
@@ -185,7 +239,14 @@ class TokenBucket:
 class ShapedAWS(FakeAWSBackend):
     """FakeAWSBackend with asymmetric per-op latency and per-API-family
     blocking throttle quotas on EVERY operation, plus per-op counters
-    for call-rate accounting."""
+    for call-rate accounting.
+
+    ``shaping_enabled`` gates the latency/quota costs only — counters
+    keep running (the drift-tick phase measures call counts with
+    shaping off), so phases that pre-build fleet state snapshot
+    ``op_counts`` and report deltas.  ``counting_enabled`` pauses the
+    counters too, for out-of-band verification reads that are neither
+    fixture nor measured work."""
 
     _SHAPED = frozenset(REAL_LATENCY)
 
@@ -194,7 +255,11 @@ class ShapedAWS(FakeAWSBackend):
         # real accounts too; every other documented invariant (name
         # shapes, port ranges, per-listener/group quotas, change-batch
         # limits) stays enforced at AWS defaults
-        kwargs.setdefault("quota_accelerators", max(N_SERVICES, N_BASELINE) + 10)
+        kwargs.setdefault(
+            "quota_accelerators", N_SERVICES + N_BASELINE + DRIFT_N + 100
+        )
+        self.shaping_enabled = True
+        self.counting_enabled = True
         super().__init__(*args, **kwargs)
         self.op_counts: dict[str, int] = {}
         self._count_lock = threading.Lock()
@@ -206,6 +271,10 @@ class ShapedAWS(FakeAWSBackend):
         with self._count_lock:
             return sum(self.op_counts.values())
 
+    def snapshot_counts(self) -> dict[str, int]:
+        with self._count_lock:
+            return dict(self.op_counts)
+
     def __getattribute__(self, name):
         attr = super().__getattribute__(name)
         if name.startswith("_") or name not in ShapedAWS._SHAPED:
@@ -213,16 +282,29 @@ class ShapedAWS(FakeAWSBackend):
         bucket = super().__getattribute__("_buckets")[OP_FAMILY[name]]
         count_lock = super().__getattribute__("_count_lock")
         op_counts = super().__getattribute__("op_counts")
+        shaped_on = super().__getattribute__("shaping_enabled")
+        counting_on = super().__getattribute__("counting_enabled")
         latency = REAL_LATENCY[name] / LATENCY_SCALE
 
         def shaped(*args, **kwargs):
-            with count_lock:
-                op_counts[name] = op_counts.get(name, 0) + 1
-            bucket.acquire()  # throttle admission (SDK-style pacing)
-            time.sleep(latency)  # server-side processing time
+            if counting_on:
+                with count_lock:
+                    op_counts[name] = op_counts.get(name, 0) + 1
+            if shaped_on:
+                bucket.acquire()  # throttle admission (SDK-style pacing)
+                time.sleep(latency)  # server-side processing time
             return attr(*args, **kwargs)
 
         return shaped
+
+
+# ---------------------------------------------------------------------------
+# workload objects
+# ---------------------------------------------------------------------------
+
+def scaled_counts(n: int) -> tuple[int, int]:
+    """(n_ingresses, n_bindings) for a fleet of ``n`` Services."""
+    return max(1, n // 10), max(1, n // 10)
 
 
 def make_service(i: int) -> Service:
@@ -247,6 +329,134 @@ def make_service(i: int) -> Service:
     return svc
 
 
+def alb_name(j: int) -> str:
+    return f"k8s-ns{j % 10}-ing{j:04d}-0a1b2c3d4e"
+
+
+def alb_hostname(j: int) -> str:
+    return f"{alb_name(j)}-111222333.us-west-2.elb.amazonaws.com"
+
+
+def make_ingress(j: int) -> Ingress:
+    """An annotated ALB Ingress.  Even ``j`` carries the listen-ports
+    JSON annotation (the reference's primary derivation path,
+    ``global_accelerator.go:521-535``); odd ``j`` derives ports from
+    its rule backends (``:537-552``)."""
+    annotations = {
+        INGRESS_CLASS_ANNOTATION: "alb",
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+        ROUTE53_HOSTNAME_ANNOTATION: f"ing{j:04d}.z{j % N_ZONES}.bench.example.com",
+    }
+    if j % 2 == 0:
+        annotations[ALB_LISTEN_PORTS_ANNOTATION] = '[{"HTTP": 80}, {"HTTPS": 443}]'
+    ing = Ingress(
+        metadata=ObjectMeta(
+            name=f"ing{j:04d}", namespace=f"ns{j % 10}", annotations=annotations
+        ),
+        spec=IngressSpec(
+            ingress_class_name="alb",
+            rules=[
+                IngressRule(
+                    host=f"ing{j:04d}.bench.example.com",
+                    http=HTTPIngressRuleValue(
+                        paths=[
+                            HTTPIngressPath(
+                                path="/",
+                                backend=IngressBackend(
+                                    service=IngressServiceBackend(
+                                        name="backend",
+                                        port=ServiceBackendPort(number=80),
+                                    )
+                                ),
+                            )
+                        ]
+                    ),
+                )
+            ],
+        ),
+    )
+    ing.status.load_balancer.ingress.append(
+        IngressLoadBalancerIngress(hostname=alb_hostname(j))
+    )
+    return ing
+
+
+def swap_partner(k: int, n: int) -> int | None:
+    """The Service index binding ``k`` swaps its serviceRef to during
+    churn: same namespace (index ≡ k mod 10), distinct LB.  None when
+    the fleet is too small to have a partner."""
+    j = k + 10
+    return j if j < n else None
+
+
+def make_binding(k: int, endpoint_group_arn: str) -> EndpointGroupBinding:
+    return EndpointGroupBinding(
+        metadata=ObjectMeta(name=f"binding{k:04d}", namespace=f"ns{k % 10}"),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn=endpoint_group_arn,
+            weight=100,
+            service_ref=ServiceReference(name=f"bench{k:04d}"),
+        ),
+    )
+
+
+def prepare_aws(aws: ShapedAWS, n: int, n_ing: int, n_egb: int) -> tuple[list, list[str]]:
+    """Register LBs + hosted zones and pre-build one out-of-band GA
+    chain per binding (cluster tag ``external`` so the controllers
+    never touch them — reference tag scoping,
+    ``global_accelerator.go:87-110``).  Runs with shaping disabled:
+    this is fixture state, not measured work."""
+    aws.shaping_enabled = False
+    try:
+        for i in range(n):
+            aws.add_load_balancer(
+                f"bench{i:04d}",
+                "us-west-2",
+                f"bench{i:04d}-0123456789abcdef.elb.us-west-2.amazonaws.com",
+            )
+        for j in range(n_ing):
+            aws.add_load_balancer(alb_name(j), "us-west-2", alb_hostname(j))
+        zones = [aws.add_hosted_zone(f"z{k}.bench.example.com") for k in range(N_ZONES)]
+        driver = AWSDriver(aws, aws, aws)
+        group_arns: list[str] = []
+        for k in range(n_egb):
+            ext_lb = f"ext{k:04d}"
+            host = f"{ext_lb}-fedcba9876543210.elb.us-west-2.amazonaws.com"
+            aws.add_load_balancer(ext_lb, "us-west-2", host)
+            svc = Service(
+                metadata=ObjectMeta(name=f"ext{k:04d}", namespace="external"),
+                spec=ServiceSpec(
+                    type="LoadBalancer",
+                    ports=[ServicePort(name="http", port=80, protocol="TCP")],
+                ),
+            )
+            svc.status.load_balancer.ingress.append(LoadBalancerIngress(hostname=host))
+            arn, _, _ = driver.ensure_global_accelerator_for_service(
+                svc, svc.status.load_balancer.ingress[0], "external", ext_lb, "us-west-2"
+            )
+            listener = driver.get_listener(arn)
+            group = driver.get_endpoint_group(listener.listener_arn)
+            group_arns.append(group.endpoint_group_arn)
+    finally:
+        aws.shaping_enabled = True
+    return zones, group_arns
+
+
+def create_objects(
+    cluster: FakeCluster, n: int, n_ing: int, n_egb: int, group_arns: list[str]
+) -> list[tuple[str, str]]:
+    for i in range(n):
+        cluster.create("Service", make_service(i))
+    for j in range(n_ing):
+        cluster.create("Ingress", make_ingress(j))
+    binding_keys = []
+    for k in range(n_egb):
+        binding = make_binding(k, group_arns[k])
+        cluster.create("EndpointGroupBinding", binding)
+        binding_keys.append((binding.metadata.namespace, binding.metadata.name))
+    return binding_keys
+
+
 def _percentile(samples: list, q: float) -> float:
     if not samples:
         return 0.0
@@ -266,6 +476,42 @@ def _controller_of(thread_name: str) -> str:
     return "other"
 
 
+def _ops_delta(before: dict[str, int], after: dict[str, int]) -> dict[str, int]:
+    return {
+        op: after[op] - before.get(op, 0)
+        for op in sorted(after)
+        if after[op] - before.get(op, 0) > 0
+    }
+
+
+def fleet_converged(
+    aws: "ShapedAWS",
+    cluster: FakeCluster,
+    zones: list,
+    binding_keys: list[tuple[str, str]],
+    base_accels: int,
+    n: int,
+    n_ing: int,
+) -> bool:
+    """The ONE convergence criterion every phase shares: all
+    accelerator chains up, every TXT+A pair written, every binding
+    bound to exactly one endpoint."""
+    if len(aws.all_accelerator_arns()) < base_accels + n + n_ing:
+        return False
+    records = sum(len(aws.records_in_zone(z.id)) for z in zones)
+    if records < 2 * (n + n_ing):
+        return False
+    for ns, name in binding_keys:
+        obj = cluster.get("EndpointGroupBinding", ns, name)
+        if len(obj.status.endpoint_ids) != 1:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# convergence + churn phase
+# ---------------------------------------------------------------------------
+
 def run_convergence(
     n: int,
     workers: int,
@@ -274,22 +520,21 @@ def run_convergence(
     qps: float = 10.0,
     burst: int = 100,
     measure_steady_state: bool = False,
+    churn: bool = False,
 ) -> dict:
-    """Create ``n`` annotated services, converge the accelerator chains
-    AND Route53 record pairs, and return a result dict with throughput,
-    per-controller sync-latency percentiles, AWS call counts, and
-    (optionally) the steady-state call rate over one resync cycle."""
+    """Create the mixed fleet (``n`` Services + n/10 Ingresses + n/10
+    EndpointGroupBindings), converge all three controllers, optionally
+    churn the bindings and measure the steady state, and return a
+    result dict."""
+    n_ing, n_egb = scaled_counts(n)
+    n_objects = n + n_ing + n_egb
     cluster = FakeCluster()
     aws = ShapedAWS()
     cache = DiscoveryCache(ttl=cache_ttl) if cache_ttl > 0 else None
     zone_cache = HostedZoneCache(ttl=zone_cache_ttl) if zone_cache_ttl > 0 else None
-    for i in range(n):
-        aws.add_load_balancer(
-            f"bench{i:04d}",
-            "us-west-2",
-            f"bench{i:04d}-0123456789abcdef.elb.us-west-2.amazonaws.com",
-        )
-    zones = [aws.add_hosted_zone(f"z{k}.bench.example.com") for k in range(N_ZONES)]
+    zones, group_arns = prepare_aws(aws, n, n_ing, n_egb)
+    setup_counts = aws.snapshot_counts()
+    base_accels = len(aws.all_accelerator_arns())
 
     latencies: dict[str, list] = {}
     lat_lock = threading.Lock()
@@ -328,29 +573,36 @@ def run_convergence(
             ),
             block=False,
         )
-        for i in range(n):
-            cluster.create("Service", make_service(i))
+        binding_keys = create_objects(cluster, n, n_ing, n_egb, group_arns)
         start = time.monotonic()
         deadline = start + DEADLINE
 
         def converged() -> bool:
-            if len(aws.all_accelerator_arns()) < n:
-                return False
-            records = sum(len(aws.records_in_zone(z.id)) for z in zones)
-            return records >= 2 * n
+            return fleet_converged(
+                aws, cluster, zones, binding_keys, base_accels, n, n_ing
+            )
 
         while time.monotonic() < deadline:
             if converged():
                 break
-            time.sleep(0.05)
+            time.sleep(0.1)
         elapsed = time.monotonic() - start
         if not converged():
-            done = len(aws.all_accelerator_arns())
+            done = len(aws.all_accelerator_arns()) - base_accels
             records = sum(len(aws.records_in_zone(z.id)) for z in zones)
             raise SystemExit(
-                f"benchmark did not converge: {done}/{n} accelerators, "
-                f"{records}/{2 * n} records"
+                f"benchmark did not converge: {done}/{n + n_ing} accelerators, "
+                f"{records}/{2 * (n + n_ing)} records"
             )
+
+        # convergence-phase ops only: churn and the steady window keep
+        # their own deltas, so the quota-floor figure below stays
+        # comparable between the churning tuned run and the baseline
+        convergence_counts = aws.snapshot_counts()
+
+        churn_result = None
+        if churn:
+            churn_result = _run_churn(cluster, aws, binding_keys, n, deadline)
 
         steady = None
         if measure_steady_state:
@@ -368,12 +620,24 @@ def run_convergence(
                 "aws_calls": aws.total_calls() - calls_before,
                 "aws_calls_per_sec": round((aws.total_calls() - calls_before) / window, 2),
                 "resync_period_s": RESYNC_PERIOD,
-                # 0 is correct, not a broken probe: resync re-delivers
-                # update(old, new) with old == new, and both this
-                # framework and the reference skip equal updates
-                # (reference controller.go:100-102 reflect.DeepEqual),
-                # so a converged fleet is AWS-quiescent between edits
-                "note": "converged level-triggered quiescence; equal resync updates are skipped (parity: reference controller.go:100-102)",
+                # Services/Ingresses are quiescent when converged: both
+                # this framework and the reference skip equal resync
+                # updates (reference globalaccelerator/controller.go:
+                # 100-102 reflect.DeepEqual).  EndpointGroupBindings are
+                # NOT: the reference's EGB handler enqueues resyncs
+                # unconditionally (endpointgroupbinding/controller.go:
+                # 84-94) and its reconcile resolves serviceRef->LB ARNs
+                # BEFORE the ObservedGeneration early return
+                # (reconcile.go:112-157), so a converged fleet pays one
+                # DescribeLoadBalancers per binding per resync — exact
+                # parity, measured here as n_bindings calls per window
+                "note": (
+                    "converged Services/Ingresses are quiescent (equal resync "
+                    "updates skipped, parity: globalaccelerator/controller.go:100-102); "
+                    "each EndpointGroupBinding pays 1 DescribeLoadBalancers per "
+                    "resync (parity: endpointgroupbinding/controller.go:84-94 + "
+                    "reconcile.go:112-157 resolve refs before the early return)"
+                ),
             }
     finally:
         remove_sync_duration_observer(observer)
@@ -392,17 +656,30 @@ def run_convergence(
     throttled = {
         family: bucket.throttled_waits for family, bucket in aws._buckets.items()
     }
+    measured_ops = _ops_delta(setup_counts, convergence_counts)
+    mutate_calls = sum(
+        count for op, count in measured_ops.items() if OP_FAMILY[op] == "ga_mutate"
+    )
     result = {
-        "services_per_sec": round(n / elapsed, 2),
-        "zone_cache_ttl_s": zone_cache_ttl,
+        "objects_per_sec": round(n_objects / elapsed, 2),
         "elapsed_s": round(elapsed, 1),
         "n_services": n,
+        "n_ingresses": n_ing,
+        "n_bindings": n_egb,
+        "n_objects": n_objects,
         "workers": workers,
         "queue_qps": qps,
         "queue_burst": burst,
         "discovery_cache_ttl_s": cache_ttl,
-        "aws_calls_total": aws.total_calls(),
-        "aws_calls_by_op": dict(sorted(aws.op_counts.items())),
+        "zone_cache_ttl_s": zone_cache_ttl,
+        "aws_calls_total": sum(measured_ops.values()),
+        "aws_calls_by_op": measured_ops,
+        # the quota floor the headline must sit near to be credible:
+        # every convergence needs mutate_calls GA mutates through a
+        # 50/s bucket, so no configuration can beat this rate
+        "ga_mutate_quota_floor_objects_per_sec": round(
+            n_objects / max(mutate_calls / QUOTAS["ga_mutate"][0], 0.001), 2
+        ),
         "throttled_acquisitions": throttled,
         "sync_latency": sync_latency,
     }
@@ -410,9 +687,225 @@ def run_convergence(
         result["discovery_cache"] = {"hits": cache.hits, "misses": cache.misses}
     if zone_cache is not None:
         result["zone_cache"] = {"hits": zone_cache.hits, "misses": zone_cache.misses}
+    if churn_result is not None:
+        result["egb_churn"] = churn_result
     if steady is not None:
         result["steady_state"] = steady
     return result
+
+
+def _run_churn(
+    cluster: FakeCluster,
+    aws: ShapedAWS,
+    binding_keys: list[tuple[str, str]],
+    n: int,
+    deadline: float,
+) -> dict:
+    """Post-convergence EndpointGroupBinding churn: every binding's
+    weight is edited (weight-sync path, reference
+    ``reconcile.go:195-202``); every binding with a same-namespace
+    partner Service swaps its serviceRef (endpoint remove + add,
+    ``reconcile.go:112-193``)."""
+    before = aws.snapshot_counts()
+    expected_gen: dict[tuple[str, str], int] = {}
+    swaps = 0
+    start = time.monotonic()
+    for k, (ns, name) in enumerate(binding_keys):
+        obj = cluster.get("EndpointGroupBinding", ns, name)
+        obj.spec.weight = 50
+        partner = swap_partner(k, n)
+        if partner is not None:
+            obj.spec.service_ref = ServiceReference(name=f"bench{partner:04d}")
+            swaps += 1
+        updated = cluster.update("EndpointGroupBinding", obj)
+        expected_gen[(ns, name)] = updated.metadata.generation
+
+    def churned() -> bool:
+        for (ns, name), gen in expected_gen.items():
+            obj = cluster.get("EndpointGroupBinding", ns, name)
+            if obj.status.observed_generation < gen or len(obj.status.endpoint_ids) != 1:
+                return False
+        return True
+
+    while time.monotonic() < deadline:
+        if churned():
+            break
+        time.sleep(0.1)
+    elapsed = time.monotonic() - start
+    if not churned():
+        raise SystemExit("EGB churn did not converge within deadline")
+
+    # verify against AWS with shaping and counting paused so the
+    # check costs neither quota nor measured-call accounting
+    aws.shaping_enabled = False
+    aws.counting_enabled = False
+    try:
+        for k, (ns, name) in enumerate(binding_keys):
+            obj = cluster.get("EndpointGroupBinding", ns, name)
+            group = aws.describe_endpoint_group(obj.spec.endpoint_group_arn)
+            weights = {d.endpoint_id: d.weight for d in group.endpoint_descriptions}
+            bound = obj.status.endpoint_ids[0]
+            if weights.get(bound) != 50:
+                raise SystemExit(
+                    f"churn verification failed: {ns}/{name} bound={bound} weights={weights}"
+                )
+            # the group also holds its pre-existing out-of-band
+            # endpoint, so status ids must be a subset, never equal
+            if not set(obj.status.endpoint_ids) <= set(weights):
+                raise SystemExit(
+                    f"churn verification failed: {ns}/{name} status id not bound in AWS"
+                )
+    finally:
+        aws.shaping_enabled = True
+        aws.counting_enabled = True
+    return {
+        "n_bindings": len(binding_keys),
+        "weight_edits": len(binding_keys),
+        "ref_swaps": swaps,
+        "elapsed_s": round(elapsed, 1),
+        "aws_calls_by_op": _ops_delta(before, aws.snapshot_counts()),
+        "verified": "every status endpoint id bound in AWS with the edited weight",
+    }
+
+
+# ---------------------------------------------------------------------------
+# drift-tick phase
+# ---------------------------------------------------------------------------
+
+def _wait_quiescent(aws: ShapedAWS, quiet_need: float, deadline: float) -> int:
+    """Block until no AWS call lands for ``quiet_need`` seconds;
+    returns the stable total."""
+    last = aws.total_calls()
+    quiet_since = time.monotonic()
+    while time.monotonic() < deadline:
+        time.sleep(0.1)
+        cur = aws.total_calls()
+        if cur != last:
+            last, quiet_since = cur, time.monotonic()
+        elif time.monotonic() - quiet_since >= quiet_need:
+            return last
+    raise SystemExit("drift-tick phase: fleet never went AWS-quiescent")
+
+
+def run_drift_tick(n: int, workers: int) -> dict:
+    """Measure ONE full --drift-resync-period tick over a converged
+    mixed fleet of ``n`` Services (+ n/10 Ingresses + n/10
+    EndpointGroupBindings).
+
+    The tick is driven explicitly: the manager runs with a dormant
+    ticker period (large enough never to fire, but > 0 so the EGB
+    converged path verifies the actual endpoint group exactly as a
+    real tick would), the fleet converges and goes AWS-quiescent
+    (equal resync updates are skipped), then every controller's OWN
+    ``drift_resync_sources()`` wiring — the same lister/predicate/
+    enqueue triples the in-process ticker consumes — is walked once.
+    Everything that lands after that IS one tick, measured to the
+    call by quiescence bracketing.
+
+    Shaping is disabled for the whole phase (convergence in seconds,
+    counters exact); tick WALL time under quota is then derived from
+    the same token-bucket model the shaped phases enforce: max over
+    families of (calls - burst) / rate."""
+    n_ing, n_egb = scaled_counts(n)
+    cluster = FakeCluster()
+    aws = ShapedAWS()
+    cache = DiscoveryCache(ttl=30.0)
+    zone_cache = HostedZoneCache(ttl=60.0)
+    zones, group_arns = prepare_aws(aws, n, n_ing, n_egb)
+    aws.shaping_enabled = False
+    base_accels = len(aws.all_accelerator_arns())
+
+    stop = threading.Event()
+    dormant = 10 * DEADLINE  # > 0 activates drift verify; never fires
+    config = ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(
+            workers=workers, queue_qps=100000.0, queue_burst=100000,
+            drift_resync_period=dormant,
+        ),
+        route53=Route53Config(
+            workers=workers, queue_qps=100000.0, queue_burst=100000,
+            drift_resync_period=dormant,
+        ),
+        endpoint_group_binding=EndpointGroupBindingConfig(
+            workers=workers, queue_qps=100000.0, queue_burst=100000,
+            drift_resync_period=dormant,
+        ),
+    )
+    manager = Manager(resync_period=RESYNC_PERIOD)
+    try:
+        manager.run(
+            cluster,
+            config,
+            stop,
+            cloud_factory=lambda region: AWSDriver(
+                aws, aws, aws,
+                discovery_cache=cache,
+                zone_cache=zone_cache,
+                accelerator_missing_retry=60.0 / LATENCY_SCALE,
+            ),
+            block=False,
+        )
+        binding_keys = create_objects(cluster, n, n_ing, n_egb, group_arns)
+        deadline = time.monotonic() + DEADLINE
+
+        def converged() -> bool:
+            return fleet_converged(
+                aws, cluster, zones, binding_keys, base_accels, n, n_ing
+            )
+
+        while time.monotonic() < deadline:
+            if converged():
+                break
+            time.sleep(0.1)
+        if not converged():
+            raise SystemExit("drift-tick phase: fleet did not converge")
+
+        quiet_need = 1.5
+        _wait_quiescent(aws, quiet_need, deadline)
+        before = aws.snapshot_counts()
+        tick_start = time.monotonic()
+        # one tick: exactly what the in-process ticker's loop does,
+        # through the controllers' own canonical source wiring
+        for controller in manager.controllers.values():
+            for lister, predicate, enqueue in controller.drift_resync_sources():
+                for obj in lister.list():
+                    if predicate(obj):
+                        enqueue(obj)
+        _wait_quiescent(aws, quiet_need, deadline)
+        drain = round(time.monotonic() - tick_start - quiet_need, 2)
+        tick_ops = _ops_delta(before, aws.snapshot_counts())
+    finally:
+        stop.set()
+
+    family_calls: dict[str, int] = {}
+    for op, count in tick_ops.items():
+        family_calls[OP_FAMILY[op]] = family_calls.get(OP_FAMILY[op], 0) + count
+    derived = {
+        family: round(max(0.0, (calls - QUOTAS[family][1]) / QUOTAS[family][0]), 1)
+        for family, calls in sorted(family_calls.items())
+    }
+    wall_bound = max(derived.values(), default=0.0)
+    return {
+        "n_services": n,
+        "n_ingresses": n_ing,
+        "n_bindings": n_egb,
+        "aws_calls_total": sum(tick_ops.values()),
+        "aws_calls_by_op": tick_ops,
+        "aws_calls_by_family": dict(sorted(family_calls.items())),
+        "unthrottled_drain_s": drain,
+        # the quota model is the same one the shaped phases enforce;
+        # with it, a tick's wall time is bounded below by the binding
+        # family's (calls - burst) / rate
+        "derived_tick_seconds_by_family_scaled": derived,
+        "derived_tick_seconds_scaled": wall_bound,
+        "derived_tick_seconds_real_quotas": round(wall_bound * LATENCY_SCALE, 1),
+        "note": (
+            "counts measured over one isolated ticker round on a converged "
+            f"fleet (caches at production TTLs); quotas are x{LATENCY_SCALE:g} "
+            f"scaled, so real-world tick wall time is x{LATENCY_SCALE:g} the "
+            "scaled bound — see docs/operations.md 'Drift resync at scale'"
+        ),
+    }
 
 
 def main():
@@ -422,17 +915,18 @@ def main():
     logging.getLogger("agac").setLevel(logging.CRITICAL)
     # baseline: the reference's operating point — 1 worker per queue,
     # client-go's fixed 10 qps/100 burst enqueue bucket, full O(N)+1
-    # tag-scan discovery on every reconcile (N_BASELINE services; see
+    # tag-scan discovery on every reconcile (N_BASELINE objects; see
     # module docstring for why the subset favors the baseline)
+    _progress(f"baseline: converging {N_BASELINE}+{sum(scaled_counts(N_BASELINE))} objects at the reference operating point")
     baseline = run_convergence(N_BASELINE, workers=1, cache_ttl=0.0, qps=10.0, burst=100)
+    _progress(f"baseline: {baseline['objects_per_sec']} objects/s in {baseline['elapsed_s']}s")
     # measured: this framework's tuned production configuration —
-    # concurrent workers, raised enqueue bucket, incremental discovery
-    # caches (AGAC_DISCOVERY_CACHE_TTL + AGAC_ZONE_CACHE_TTL) —
-    # against the full N.  Under the realistic quota model throughput
-    # is GA-mutate-quota-bound and plateaus from 8 workers up (15.49
-    # at w=8 → 16.43 at w=32 svc/s, docs/operations.md "Sizing the
-    # worker pool"); 32 sits at the plateau top, while the docs
-    # recommend 8–16 where p99 matters
+    # the documented 8-16 worker band's top, raised enqueue bucket,
+    # incremental discovery caches (AGAC_DISCOVERY_CACHE_TTL +
+    # AGAC_ZONE_CACHE_TTL) — against the full N.  Under the realistic
+    # quota model throughput is GA-mutate-quota-bound and plateaus
+    # from 8 workers up (docs/operations.md "Sizing the worker pool")
+    _progress(f"tuned: converging {N_SERVICES}+{sum(scaled_counts(N_SERVICES))} objects at workers={TUNED_WORKERS}")
     tuned = run_convergence(
         N_SERVICES,
         workers=TUNED_WORKERS,
@@ -444,37 +938,68 @@ def main():
         qps=1000.0,
         burst=1000,
         measure_steady_state=True,
+        churn=True,
     )
+    _progress(f"tuned: {tuned['objects_per_sec']} objects/s in {tuned['elapsed_s']}s")
+    _progress(f"drift tick: measuring one ticker round over {DRIFT_N} services")
+    drift = run_drift_tick(DRIFT_N, workers=TUNED_WORKERS)
+    _progress(f"drift tick: {drift['aws_calls_total']} AWS calls/tick")
+
     steady = tuned.pop("steady_state")
-    print(
-        json.dumps(
-            {
-                "metric": "service_to_accelerator_convergence_throughput",
-                "value": tuned["services_per_sec"],
-                "unit": "services/sec",
-                "vs_baseline": round(
-                    tuned["services_per_sec"] / baseline["services_per_sec"], 2
-                ),
-                "detail": {
-                    "workload": (
-                        "each Service needs an accelerator+listener+endpoint-group "
-                        "chain AND an atomic TXT+A Route53 record pair"
-                    ),
-                    "baseline": baseline,
-                    "tuned": tuned,
-                    "steady_state": steady,
-                    "latency_model": {
-                        "scale": f"real-world seconds / {LATENCY_SCALE:g}; quotas x{LATENCY_SCALE:g}",
-                        "real_latency_s": REAL_LATENCY,
-                        "quotas_scaled_per_sec": {
-                            family: {"rate": rate, "burst": burst_}
-                            for family, (rate, burst_) in QUOTAS.items()
-                        },
-                    },
-                },
-            }
-        )
-    )
+    churn = tuned.pop("egb_churn")
+    detail = {
+        "workload": (
+            "N Services (accelerator chain + atomic TXT/A pair) + N/10 ALB "
+            "Ingresses (listen-ports listener derivation + records) + N/10 "
+            "EndpointGroupBindings (bind, then weight-edit + serviceRef-swap churn)"
+        ),
+        "baseline": baseline,
+        "tuned": tuned,
+        "steady_state": steady,
+        "egb_churn": churn,
+        "drift_tick": drift,
+        "latency_model": {
+            "scale": f"real-world seconds / {LATENCY_SCALE:g}; quotas x{LATENCY_SCALE:g}",
+            "real_latency_s": REAL_LATENCY,
+            "quotas_scaled_per_sec": {
+                family: {"rate": rate, "burst": burst_}
+                for family, (rate, burst_) in QUOTAS.items()
+            },
+        },
+    }
+    with open(DETAIL_PATH, "w") as f:
+        json.dump(detail, f, indent=1, sort_keys=True)
+        f.write("\n")
+    _progress(f"detail written to {DETAIL_PATH}")
+
+    # the compact headline record — the ONLY stdout line, kept < 1 KB
+    # so a tail-window capture always carries metric/value/vs_baseline
+    # (VERDICT r4 #1; tests/test_bench_output.py pins the budget)
+    headline = {
+        "metric": "mixed_workload_convergence_throughput",
+        "value": tuned["objects_per_sec"],
+        "unit": "objects/sec",
+        "vs_baseline": round(tuned["objects_per_sec"] / baseline["objects_per_sec"], 2),
+        "vs_baseline_note": "baseline = this code pinned to the reference's operating point (the reference publishes no numbers)",
+        "n_objects": tuned["n_objects"],
+        "workers": tuned["workers"],
+        "aws_calls_total": tuned["aws_calls_total"],
+        "ga_mutate_quota_floor_objects_per_sec": tuned[
+            "ga_mutate_quota_floor_objects_per_sec"
+        ],
+        "sync_p99_s": {
+            label: stats["p99_s"] for label, stats in tuned["sync_latency"].items()
+        },
+        "steady_aws_calls_per_sec": steady["aws_calls_per_sec"],
+        "egb_churn_s": churn["elapsed_s"],
+        "drift_tick": {
+            "aws_calls": drift["aws_calls_total"],
+            "derived_s_scaled": drift["derived_tick_seconds_scaled"],
+            "derived_s_real": drift["derived_tick_seconds_real_quotas"],
+        },
+        "detail_file": os.path.basename(DETAIL_PATH),
+    }
+    print(json.dumps(headline, separators=(",", ":")))
 
 
 if __name__ == "__main__":

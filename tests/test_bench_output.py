@@ -1,0 +1,113 @@
+"""The benchmark's output contract (VERDICT r4 #1).
+
+The round driver records only a ~2 KB tail of bench stdout, so the
+FINAL stdout line must be a compact JSON object that alone carries
+``metric``/``value``/``unit``/``vs_baseline`` — round 4's measured
+result was lost because one multi-KB line outgrew the tail window.
+This tier runs the real ``bench.py`` as a subprocess on a tiny fleet
+and pins:
+
+- the last stdout line parses as JSON and stays under 1 KB;
+- it carries the headline keys plus the scalars the record needs;
+- the detail blob lands in ``bench_detail.json`` (committed artifact)
+  with all three controllers' sync latencies and the EGB churn /
+  drift-tick sections (VERDICT r4 #2/#3 coverage proof).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO_ROOT, "bench.py")
+
+# N=12 is the smallest fleet where a binding has a same-namespace swap
+# partner (k + 10 < N), so the churn phase exercises endpoint REMOVE
+# as well as add/weight
+TINY_ENV = {
+    "AGAC_BENCH_N": "12",
+    "AGAC_BENCH_N_BASELINE": "4",
+    "AGAC_BENCH_WORKERS": "4",
+    "AGAC_BENCH_STEADY_WINDOW": "0.5",
+    "AGAC_BENCH_DRIFT_N": "12",
+}
+
+
+@pytest.fixture(scope="module")
+def detail_path(tmp_path_factory):
+    # NEVER the repo-root bench_detail.json: that file is the committed
+    # full-scale record and a tiny-fleet run must not clobber it
+    return str(tmp_path_factory.mktemp("bench") / "bench_detail.json")
+
+
+@pytest.fixture(scope="module")
+def bench_run(detail_path):
+    env = dict(os.environ)
+    env.update(TINY_ENV)
+    env["AGAC_BENCH_DETAIL_PATH"] = detail_path
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO_ROOT,
+        timeout=280,
+    )
+    assert proc.returncode == 0, f"bench failed:\n{proc.stderr[-2000:]}"
+    return proc
+
+
+def test_last_stdout_line_is_compact_parseable_headline(bench_run):
+    lines = [ln for ln in bench_run.stdout.splitlines() if ln.strip()]
+    last = lines[-1]
+    # the driver's tail window is ~2 KB; demand half that so the line
+    # survives even with other output prepended
+    assert len(last.encode()) < 1024, f"headline line is {len(last)} bytes"
+    headline = json.loads(last)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in headline, f"headline missing {key!r}"
+    assert headline["unit"] == "objects/sec"
+    assert headline["value"] > 0
+    assert headline["vs_baseline"] > 0
+    # the scalars the round record should carry
+    for key in ("workers", "n_objects", "aws_calls_total", "sync_p99_s", "drift_tick"):
+        assert key in headline
+    assert headline["detail_file"] == "bench_detail.json"
+
+
+def test_stdout_carries_nothing_but_the_headline(bench_run):
+    # progress/log chatter must go to stderr: any extra stdout eats
+    # into the driver's tail window
+    lines = [ln for ln in bench_run.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {len(lines)}"
+
+
+def test_detail_artifact_written_and_complete(bench_run, detail_path):
+    with open(detail_path) as f:
+        detail = json.load(f)
+    # all three controllers measured (VERDICT r4 #2)
+    assert set(detail["tuned"]["sync_latency"]) == {
+        "globalaccelerator",
+        "route53",
+        "endpointgroupbinding",
+    }
+    tuned_ops = detail["tuned"]["aws_calls_by_op"]
+    assert tuned_ops.get("add_endpoints", 0) > 0, "EGB bind path unmeasured"
+    assert tuned_ops.get("change_resource_record_sets", 0) > 0
+    # churn exercised add + remove + weight (VERDICT r4 #2)
+    churn = detail["egb_churn"]
+    assert churn["ref_swaps"] >= 1
+    assert churn["aws_calls_by_op"].get("remove_endpoints", 0) >= 1
+    assert churn["aws_calls_by_op"].get("add_endpoints", 0) >= 1
+    assert churn["aws_calls_by_op"].get("update_endpoint_group", 0) >= 1
+    # drift-tick section present with per-op counts (VERDICT r4 #3)
+    drift = detail["drift_tick"]
+    assert drift["aws_calls_total"] > 0
+    assert drift["aws_calls_by_op"]
+    assert "derived_tick_seconds_real_quotas" in drift
+    # baseline ran the same mixed workload
+    assert detail["baseline"]["n_bindings"] >= 1
+    assert detail["baseline"]["n_ingresses"] >= 1
