@@ -114,6 +114,25 @@ RETRYABLE_CODES = frozenset(
 )
 
 
+# Exception classes a malformed (truncated, garbage, wrong-protocol)
+# response body can raise out of json/ElementTree parsing or the
+# shape-mapping code below.  Every parse is wrapped so these surface
+# as a diagnosable AWSAPIError naming the operation — never a raw
+# ParseError/KeyError traceback into the reconcile loop, which would
+# be retried as an anonymous error forever.  The analog of
+# aws-sdk-go-v2's DeserializationError wrapping, which the reference
+# gets from the SDK (go.mod:8-13).
+_MALFORMED = (AttributeError, TypeError, ValueError, KeyError, IndexError)
+
+
+def _deserialization_error(operation: str, why, body: bytes) -> AWSAPIError:
+    return AWSAPIError(
+        "DeserializationError",
+        f"{operation}: malformed response from service ({why}); "
+        f"body[:200]={body[:200].decode(errors='replace')!r}",
+    )
+
+
 def _ga_error_code(body: bytes) -> str:
     """Service code from an AWS JSON-1.1 error body (``__type``)."""
     try:
@@ -220,18 +239,22 @@ class _SignedClient:
 # ---------------------------------------------------------------------------
 
 
-def _ga_error(status: int, body: bytes) -> AWSAPIError:
+def _ga_error(operation: str, status: int, body: bytes) -> AWSAPIError:
     code = _ga_error_code(body) or "UnknownError"
     try:
         payload = json.loads(body)
+        if not isinstance(payload, dict):
+            raise ValueError("not an object")
         message = payload.get("message") or payload.get("Message") or ""
     except Exception:
+        # half-written/garbage error envelope: still a typed error
+        # naming the operation, with the body excerpt for diagnosis
         message = body[:200].decode(errors="replace")
     if code == ERR_LISTENER_NOT_FOUND:
         return ListenerNotFoundException(message)
     if code == ERR_ENDPOINT_GROUP_NOT_FOUND:
         return EndpointGroupNotFoundException(message)
-    return AWSAPIError(code, message or f"HTTP {status}")
+    return AWSAPIError(code, f"{operation}: {message or f'HTTP {status}'}")
 
 
 def _accelerator_from_json(data: dict) -> Accelerator:
@@ -303,7 +326,11 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
             error_code_parser=_ga_error_code,
         )
 
-    def _call(self, operation: str, payload: dict) -> dict:
+    def _call(self, operation: str, payload: dict, parse=None):
+        """POST one JSON-1.1 operation.  ``parse`` maps the decoded
+        response dict to the return value; any malformed body — not
+        JSON, not an object, or a shape the mapper chokes on — raises
+        ``AWSAPIError("DeserializationError")`` naming the operation."""
         body = json.dumps(payload).encode()
         status, response = self._client.request(
             "POST",
@@ -315,26 +342,45 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
             body,
         )
         if status >= 300:
-            raise _ga_error(status, response)
-        return json.loads(response) if response else {}
+            raise _ga_error(operation, status, response)
+        try:
+            data = json.loads(response) if response else {}
+        except ValueError as err:
+            raise _deserialization_error(operation, err, response) from err
+        if not isinstance(data, dict):
+            raise _deserialization_error(
+                operation, f"expected JSON object, got {type(data).__name__}", response
+            )
+        if parse is None:
+            return data
+        try:
+            return parse(data)
+        except _MALFORMED as err:
+            raise _deserialization_error(operation, repr(err), response) from err
 
     # accelerators
     def list_accelerators(self, max_results, next_token):
         payload: dict = {"MaxResults": max_results}
         if next_token:
             payload["NextToken"] = next_token
-        data = self._call("ListAccelerators", payload)
-        return (
-            [_accelerator_from_json(a) for a in data.get("Accelerators", [])],
-            data.get("NextToken"),
+        return self._call(
+            "ListAccelerators",
+            payload,
+            parse=lambda data: (
+                [_accelerator_from_json(a) for a in data.get("Accelerators", [])],
+                data.get("NextToken"),
+            ),
         )
 
     def describe_accelerator(self, arn):
-        data = self._call("DescribeAccelerator", {"AcceleratorArn": arn})
-        return _accelerator_from_json(data.get("Accelerator", {}))
+        return self._call(
+            "DescribeAccelerator",
+            {"AcceleratorArn": arn},
+            parse=lambda data: _accelerator_from_json(data.get("Accelerator", {})),
+        )
 
     def create_accelerator(self, name, ip_address_type, enabled, tags):
-        data = self._call(
+        return self._call(
             "CreateAccelerator",
             {
                 "Name": name,
@@ -347,8 +393,8 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
                 # (the SDK auto-fills this field for the reference)
                 "IdempotencyToken": uuid.uuid4().hex,
             },
+            parse=lambda data: _accelerator_from_json(data.get("Accelerator", {})),
         )
-        return _accelerator_from_json(data.get("Accelerator", {}))
 
     def update_accelerator(self, arn, name=None, enabled=None):
         payload: dict = {"AcceleratorArn": arn}
@@ -356,15 +402,23 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
             payload["Name"] = name
         if enabled is not None:
             payload["Enabled"] = enabled
-        data = self._call("UpdateAccelerator", payload)
-        return _accelerator_from_json(data.get("Accelerator", {}))
+        return self._call(
+            "UpdateAccelerator",
+            payload,
+            parse=lambda data: _accelerator_from_json(data.get("Accelerator", {})),
+        )
 
     def delete_accelerator(self, arn):
         self._call("DeleteAccelerator", {"AcceleratorArn": arn})
 
     def list_tags_for_resource(self, arn):
-        data = self._call("ListTagsForResource", {"ResourceArn": arn})
-        return [Tag(t.get("Key", ""), t.get("Value", "")) for t in data.get("Tags", [])]
+        return self._call(
+            "ListTagsForResource",
+            {"ResourceArn": arn},
+            parse=lambda data: [
+                Tag(t.get("Key", ""), t.get("Value", "")) for t in data.get("Tags", [])
+            ],
+        )
 
     def tag_resource(self, arn, tags):
         self._call(
@@ -380,14 +434,17 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
         payload: dict = {"AcceleratorArn": accelerator_arn, "MaxResults": max_results}
         if next_token:
             payload["NextToken"] = next_token
-        data = self._call("ListListeners", payload)
-        return (
-            [_listener_from_json(l) for l in data.get("Listeners", [])],
-            data.get("NextToken"),
+        return self._call(
+            "ListListeners",
+            payload,
+            parse=lambda data: (
+                [_listener_from_json(l) for l in data.get("Listeners", [])],
+                data.get("NextToken"),
+            ),
         )
 
     def create_listener(self, accelerator_arn, port_ranges, protocol, client_affinity):
-        data = self._call(
+        return self._call(
             "CreateListener",
             {
                 "AcceleratorArn": accelerator_arn,
@@ -398,11 +455,11 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
                 "ClientAffinity": client_affinity,
                 "IdempotencyToken": uuid.uuid4().hex,
             },
+            parse=lambda data: _listener_from_json(data.get("Listener", {})),
         )
-        return _listener_from_json(data.get("Listener", {}))
 
     def update_listener(self, listener_arn, port_ranges, protocol, client_affinity):
-        data = self._call(
+        return self._call(
             "UpdateListener",
             {
                 "ListenerArn": listener_arn,
@@ -412,8 +469,8 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
                 "Protocol": protocol,
                 "ClientAffinity": client_affinity,
             },
+            parse=lambda data: _listener_from_json(data.get("Listener", {})),
         )
-        return _listener_from_json(data.get("Listener", {}))
 
     def delete_listener(self, arn):
         self._call("DeleteListener", {"ListenerArn": arn})
@@ -423,18 +480,24 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
         payload: dict = {"ListenerArn": listener_arn, "MaxResults": max_results}
         if next_token:
             payload["NextToken"] = next_token
-        data = self._call("ListEndpointGroups", payload)
-        return (
-            [_endpoint_group_from_json(g) for g in data.get("EndpointGroups", [])],
-            data.get("NextToken"),
+        return self._call(
+            "ListEndpointGroups",
+            payload,
+            parse=lambda data: (
+                [_endpoint_group_from_json(g) for g in data.get("EndpointGroups", [])],
+                data.get("NextToken"),
+            ),
         )
 
     def describe_endpoint_group(self, arn):
-        data = self._call("DescribeEndpointGroup", {"EndpointGroupArn": arn})
-        return _endpoint_group_from_json(data.get("EndpointGroup", {}))
+        return self._call(
+            "DescribeEndpointGroup",
+            {"EndpointGroupArn": arn},
+            parse=lambda data: _endpoint_group_from_json(data.get("EndpointGroup", {})),
+        )
 
     def create_endpoint_group(self, listener_arn, endpoint_group_region, endpoint_configurations):
-        data = self._call(
+        return self._call(
             "CreateEndpointGroup",
             {
                 "ListenerArn": listener_arn,
@@ -444,11 +507,11 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
                 ),
                 "IdempotencyToken": uuid.uuid4().hex,
             },
+            parse=lambda data: _endpoint_group_from_json(data.get("EndpointGroup", {})),
         )
-        return _endpoint_group_from_json(data.get("EndpointGroup", {}))
 
     def update_endpoint_group(self, arn, endpoint_configurations):
-        data = self._call(
+        return self._call(
             "UpdateEndpointGroup",
             {
                 "EndpointGroupArn": arn,
@@ -456,14 +519,14 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
                     endpoint_configurations
                 ),
             },
+            parse=lambda data: _endpoint_group_from_json(data.get("EndpointGroup", {})),
         )
-        return _endpoint_group_from_json(data.get("EndpointGroup", {}))
 
     def delete_endpoint_group(self, arn):
         self._call("DeleteEndpointGroup", {"EndpointGroupArn": arn})
 
     def add_endpoints(self, arn, endpoint_configurations):
-        data = self._call(
+        return self._call(
             "AddEndpoints",
             {
                 "EndpointGroupArn": arn,
@@ -471,17 +534,17 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
                     endpoint_configurations
                 ),
             },
+            parse=lambda data: [
+                EndpointDescription(
+                    endpoint_id=d.get("EndpointId", ""),
+                    weight=d.get("Weight"),
+                    client_ip_preservation_enabled=bool(
+                        d.get("ClientIPPreservationEnabled", False)
+                    ),
+                )
+                for d in data.get("EndpointDescriptions", [])
+            ],
         )
-        return [
-            EndpointDescription(
-                endpoint_id=d.get("EndpointId", ""),
-                weight=d.get("Weight"),
-                client_ip_preservation_enabled=bool(
-                    d.get("ClientIPPreservationEnabled", False)
-                ),
-            )
-            for d in data.get("EndpointDescriptions", [])
-        ]
 
     def remove_endpoints(self, arn, endpoint_ids):
         self._call(
@@ -500,14 +563,38 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
 # ---------------------------------------------------------------------------
 
 
-def _xml_error(status: int, body: bytes) -> AWSAPIError:
+def _xml_error(operation: str, status: int, body: bytes) -> AWSAPIError:
     try:
         root = xml_strip_ns(ET.fromstring(body))
     except ET.ParseError:
-        return AWSAPIError("UnknownError", body[:200].decode(errors="replace"))
+        # half-written/garbage error envelope: still a typed error
+        # naming the operation, with the body excerpt for diagnosis
+        return AWSAPIError(
+            "UnknownError",
+            f"{operation}: HTTP {status} with unparseable body: "
+            f"{body[:200].decode(errors='replace')!r}",
+        )
     return AWSAPIError(
-        root.findtext(".//Code") or "UnknownError", root.findtext(".//Message") or ""
+        root.findtext(".//Code") or "UnknownError",
+        f"{operation}: {root.findtext('.//Message') or f'HTTP {status}'}",
     )
+
+
+def _parse_xml_response(operation: str, expected_root: str, body: bytes) -> ET.Element:
+    """Parse a 2xx XML response body, validating the document is the
+    operation's response document.  The root-tag check matters: an
+    HTML error page or wrong-protocol body often still parses as XML,
+    and without it ``findall`` would quietly return nothing — absence
+    where the truth is 'the response was garbage'."""
+    try:
+        root = xml_strip_ns(ET.fromstring(body))
+    except ET.ParseError as err:
+        raise _deserialization_error(operation, err, body) from err
+    if root.tag != expected_root:
+        raise _deserialization_error(
+            operation, f"expected <{expected_root}>, got <{root.tag}>", body
+        )
+    return root
 
 
 class RealELBv2API(ELBv2API):
@@ -537,11 +624,12 @@ class RealELBv2API(ELBv2API):
             body,
         )
         if status >= 300:
-            raise _xml_error(status, response)
-        root = xml_strip_ns(ET.fromstring(response))
-        out = []
-        for member in root.findall(".//LoadBalancers/member"):
-            out.append(
+            raise _xml_error("DescribeLoadBalancers", status, response)
+        root = _parse_xml_response(
+            "DescribeLoadBalancers", "DescribeLoadBalancersResponse", response
+        )
+        try:
+            return [
                 LoadBalancer(
                     load_balancer_arn=member.findtext("LoadBalancerArn", ""),
                     load_balancer_name=member.findtext("LoadBalancerName", ""),
@@ -550,8 +638,12 @@ class RealELBv2API(ELBv2API):
                     type=member.findtext("Type", ""),
                     scheme=member.findtext("Scheme", ""),
                 )
-            )
-        return out
+                for member in root.findall(".//LoadBalancers/member")
+            ]
+        except _MALFORMED as err:
+            raise _deserialization_error(
+                "DescribeLoadBalancers", repr(err), response
+            ) from err
 
 
 # ---------------------------------------------------------------------------
@@ -622,11 +714,11 @@ class RealRoute53API(Route53API):
             sleep=sleep,
         )
 
-    def _get(self, path: str) -> ET.Element:
+    def _get(self, operation: str, expected_root: str, path: str) -> ET.Element:
         status, response = self._client.request("GET", path, {}, b"")
         if status >= 300:
-            raise _xml_error(status, response)
-        return xml_strip_ns(ET.fromstring(response))
+            raise _xml_error(operation, status, response)
+        return _parse_xml_response(operation, expected_root, response)
 
     @staticmethod
     def _zone_from_xml(element: ET.Element) -> HostedZone:
@@ -639,7 +731,9 @@ class RealRoute53API(Route53API):
         if marker:
             query["marker"] = marker
         root = self._get(
-            f"/{ROUTE53_API_VERSION}/hostedzone?{urllib.parse.urlencode(query)}"
+            "ListHostedZones",
+            "ListHostedZonesResponse",
+            f"/{ROUTE53_API_VERSION}/hostedzone?{urllib.parse.urlencode(query)}",
         )
         zones = [
             self._zone_from_xml(z) for z in root.findall(".//HostedZones/HostedZone")
@@ -649,7 +743,11 @@ class RealRoute53API(Route53API):
 
     def list_hosted_zones_by_name(self, dns_name, max_items):
         query = urllib.parse.urlencode({"dnsname": dns_name, "maxitems": str(max_items)})
-        root = self._get(f"/{ROUTE53_API_VERSION}/hostedzonesbyname?{query}")
+        root = self._get(
+            "ListHostedZonesByName",
+            "ListHostedZonesByNameResponse",
+            f"/{ROUTE53_API_VERSION}/hostedzonesbyname?{query}",
+        )
         return [
             self._zone_from_xml(z) for z in root.findall(".//HostedZones/HostedZone")
         ]
@@ -660,12 +758,21 @@ class RealRoute53API(Route53API):
         if start_record_name:
             query["name"] = start_record_name
         root = self._get(
-            f"/{ROUTE53_API_VERSION}/hostedzone/{zone}/rrset?{urllib.parse.urlencode(query)}"
+            "ListResourceRecordSets",
+            "ListResourceRecordSetsResponse",
+            f"/{ROUTE53_API_VERSION}/hostedzone/{zone}/rrset?{urllib.parse.urlencode(query)}",
         )
-        records = [
-            _record_set_from_xml(r)
-            for r in root.findall(".//ResourceRecordSets/ResourceRecordSet")
-        ]
+        try:
+            records = [
+                _record_set_from_xml(r)
+                for r in root.findall(".//ResourceRecordSets/ResourceRecordSet")
+            ]
+        except _MALFORMED as err:
+            # e.g. a non-numeric TTL: typed, named, never a raw
+            # ValueError into the reconcile loop
+            raise _deserialization_error(
+                "ListResourceRecordSets", repr(err), ET.tostring(root)
+            ) from err
         next_name = root.findtext("NextRecordName")
         is_truncated = root.findtext("IsTruncated") == "true"
         return records, (next_name if is_truncated else None)
@@ -687,7 +794,7 @@ class RealRoute53API(Route53API):
             body,
         )
         if status >= 300:
-            raise _xml_error(status, response)
+            raise _xml_error("ChangeResourceRecordSets", status, response)
 
 
 _process_provider: Optional[CredentialProvider] = None

@@ -457,3 +457,225 @@ def test_from_environment_shares_one_credential_provider(monkeypatch):
     b = real_backend.RealAWSClients.from_environment("eu-west-1")
     assert a.ga._client._provider is b.route53._client._provider
     assert a.elbv2._client._provider is b.elbv2._client._provider
+
+
+class TestMalformedResponses:
+    """Malformed-response hardening (VERDICT r4 #5): truncated/garbage
+    JSON and XML, wrong content (HTML error pages, wrong-protocol
+    documents), and half-written error envelopes must ALL surface as a
+    diagnosable ``AWSAPIError`` naming the operation — never a raw
+    ``json.JSONDecodeError`` / ``ET.ParseError`` / ``AttributeError``
+    traceback into the reconcile loop, which would retry it forever as
+    an anonymous error.  The analog of aws-sdk-go-v2's deserialization
+    error wrapping the reference gets from the SDK (go.mod:8-13)."""
+
+    HTML = b"<html><body><h1>502 Bad Gateway</h1></body></html>"
+
+    # --- helpers ---------------------------------------------------------
+
+    def ga(self):
+        stub = StubTransport()
+        # attempts=3 on purpose: a deserialization failure is NOT a
+        # transport failure and must not be retried — one queued
+        # response is enough (a retry would pop an empty queue)
+        return (
+            RealGlobalAcceleratorAPI(
+                credentials=CREDS, transport=stub, sleep=lambda _: None
+            ),
+            stub,
+        )
+
+    def elbv2(self):
+        stub = StubTransport()
+        return (
+            RealELBv2API(
+                "us-west-2", credentials=CREDS, transport=stub, sleep=lambda _: None
+            ),
+            stub,
+        )
+
+    def r53(self):
+        stub = StubTransport()
+        return (
+            RealRoute53API(credentials=CREDS, transport=stub, sleep=lambda _: None),
+            stub,
+        )
+
+    def assert_deserialization_error(self, exc_info, operation):
+        err = exc_info.value
+        assert err.code == "DeserializationError"
+        assert operation in str(err), f"operation not named: {err}"
+
+    # --- Global Accelerator (JSON 1.1) -----------------------------------
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            b'{"Accelerators": [{',          # truncated mid-object
+            b"\x00\xff\xfenot json at all",  # binary garbage
+            b"<html><body>502</body></html>",  # wrong content type
+            b'"just a string"',              # valid JSON, not an object
+            b"[1, 2, 3]",                    # valid JSON, wrong top-level type
+        ],
+    )
+    def test_ga_unparseable_bodies(self, body):
+        client, stub = self.ga()
+        stub.queue(200, body)
+        with pytest.raises(AWSAPIError) as exc:
+            client.list_accelerators(100, None)
+        self.assert_deserialization_error(exc, "ListAccelerators")
+        assert len(stub.requests) == 1  # no retry for deserialization
+
+    @pytest.mark.parametrize(
+        "operation,call,body",
+        [
+            (
+                "ListAccelerators",
+                lambda c: c.list_accelerators(100, None),
+                {"Accelerators": "not-a-list-of-objects"},
+            ),
+            (
+                "DescribeAccelerator",
+                lambda c: c.describe_accelerator("arn:x"),
+                {"Accelerator": [1, 2]},
+            ),
+            (
+                "ListListeners",
+                lambda c: c.list_listeners("arn:x", 100, None),
+                {"Listeners": [{"PortRanges": [5]}]},
+            ),
+            (
+                "DescribeEndpointGroup",
+                lambda c: c.describe_endpoint_group("arn:x"),
+                {"EndpointGroup": {"EndpointDescriptions": ["bare-string"]}},
+            ),
+            (
+                "AddEndpoints",
+                lambda c: c.add_endpoints("arn:x", []),
+                {"EndpointDescriptions": [17]},
+            ),
+            (
+                "ListTagsForResource",
+                lambda c: c.list_tags_for_resource("arn:x"),
+                {"Tags": ["oops"]},
+            ),
+        ],
+    )
+    def test_ga_wrong_shapes(self, operation, call, body):
+        client, stub = self.ga()
+        stub.queue(200, body)
+        with pytest.raises(AWSAPIError) as exc:
+            call(client)
+        self.assert_deserialization_error(exc, operation)
+
+    def test_ga_half_written_error_envelope(self):
+        client, stub = self.ga()
+        stub.queue(400, b'{"__type":"SomeError","mess')  # torn mid-key
+        with pytest.raises(AWSAPIError) as exc:
+            client.describe_accelerator("arn:x")
+        # typed, names the operation, carries the body excerpt
+        assert exc.value.code == "UnknownError"
+        assert "DescribeAccelerator" in str(exc.value)
+        assert "mess" in str(exc.value)
+
+    def test_ga_error_envelope_that_is_not_an_object(self):
+        client, stub = self.ga()
+        stub.queue(400, b'["an", "array"]')
+        with pytest.raises(AWSAPIError) as exc:
+            client.delete_accelerator("arn:x")
+        assert exc.value.code == "UnknownError"
+        assert "DeleteAccelerator" in str(exc.value)
+
+    # --- ELBv2 (Query XML) ------------------------------------------------
+
+    @pytest.mark.parametrize(
+        "body",
+        [
+            b"<DescribeLoadBalancersResponse><LoadBalancers><member>",  # truncated
+            b"\x00\xff binary garbage",
+            b'{"json": "not xml"}',
+        ],
+    )
+    def test_elbv2_unparseable_bodies(self, body):
+        client, stub = self.elbv2()
+        stub.queue(200, body)
+        with pytest.raises(AWSAPIError) as exc:
+            client.describe_load_balancers(["my-lb"])
+        self.assert_deserialization_error(exc, "DescribeLoadBalancers")
+        assert len(stub.requests) == 1
+
+    def test_elbv2_html_page_rejected_not_silently_empty(self):
+        """An HTML error page IS well-formed XML; without root-tag
+        validation it would parse to an empty LB list — absence where
+        the truth is 'the response was garbage'."""
+        client, stub = self.elbv2()
+        stub.queue(200, self.HTML)
+        with pytest.raises(AWSAPIError) as exc:
+            client.describe_load_balancers(["my-lb"])
+        self.assert_deserialization_error(exc, "DescribeLoadBalancers")
+        assert "html" in str(exc.value)
+
+    def test_elbv2_half_written_error_envelope(self):
+        client, stub = self.elbv2()
+        stub.queue(400, b"<ErrorResponse><Error><Code>Val")  # torn
+        with pytest.raises(AWSAPIError) as exc:
+            client.describe_load_balancers(["my-lb"])
+        assert exc.value.code == "UnknownError"
+        assert "DescribeLoadBalancers" in str(exc.value)
+
+    # --- Route53 (REST XML) -----------------------------------------------
+
+    def test_route53_garbage_body(self):
+        client, stub = self.r53()
+        stub.queue(200, b"%%% not xml %%%")
+        with pytest.raises(AWSAPIError) as exc:
+            client.list_hosted_zones(100, None)
+        self.assert_deserialization_error(exc, "ListHostedZones")
+
+    def test_route53_html_page_rejected(self):
+        client, stub = self.r53()
+        stub.queue(200, self.HTML)
+        with pytest.raises(AWSAPIError) as exc:
+            client.list_hosted_zones_by_name("example.com.", 1)
+        self.assert_deserialization_error(exc, "ListHostedZonesByName")
+
+    def test_route53_wrong_document_rejected(self):
+        """A valid response document for a DIFFERENT operation is
+        still a deserialization error, not an empty result."""
+        client, stub = self.r53()
+        stub.queue(
+            200,
+            b'<?xml version="1.0"?><ListHostedZonesResponse '
+            b'xmlns="https://route53.amazonaws.com/doc/2013-04-01/">'
+            b"<HostedZones/></ListHostedZonesResponse>",
+        )
+        with pytest.raises(AWSAPIError) as exc:
+            client.list_resource_record_sets("/hostedzone/Z1", 300, None)
+        self.assert_deserialization_error(exc, "ListResourceRecordSets")
+
+    def test_route53_non_numeric_ttl(self):
+        client, stub = self.r53()
+        stub.queue(
+            200,
+            b'<?xml version="1.0"?><ListResourceRecordSetsResponse '
+            b'xmlns="https://route53.amazonaws.com/doc/2013-04-01/">'
+            b"<ResourceRecordSets><ResourceRecordSet>"
+            b"<Name>a.example.com.</Name><Type>TXT</Type><TTL>NaN</TTL>"
+            b"</ResourceRecordSet></ResourceRecordSets>"
+            b"<IsTruncated>false</IsTruncated></ListResourceRecordSetsResponse>",
+        )
+        with pytest.raises(AWSAPIError) as exc:
+            client.list_resource_record_sets("/hostedzone/Z1", 300, None)
+        self.assert_deserialization_error(exc, "ListResourceRecordSets")
+
+    def test_route53_half_written_error_envelope(self):
+        client, stub = self.r53()
+        stub.queue(500, b"<ErrorResponse><Error><Co")
+        # 500 IS retryable (transient), so exhaust the retry budget
+        # with the same torn body each time
+        stub.queue(500, b"<ErrorResponse><Error><Co")
+        stub.queue(500, b"<ErrorResponse><Error><Co")
+        with pytest.raises(AWSAPIError) as exc:
+            client.list_hosted_zones(100, None)
+        assert exc.value.code == "UnknownError"
+        assert "ListHostedZones" in str(exc.value)

@@ -351,7 +351,14 @@ def test_route53_list_hosted_zones_request_path():
 
 
 def test_route53_list_hosted_zones_by_name_request_path():
-    transport = CaptureTransport(R53_EMPTY_ZONES)
+    # its own response document per the 2013-04-01 schema — the
+    # backend's root-tag validation rejects a ListHostedZonesResponse
+    transport = CaptureTransport(
+        b'<?xml version="1.0" encoding="UTF-8"?>\n'
+        b'<ListHostedZonesByNameResponse xmlns="https://route53.amazonaws.com/doc/2013-04-01/">'
+        b"<HostedZones></HostedZones><IsTruncated>false</IsTruncated>"
+        b"</ListHostedZonesByNameResponse>"
+    )
     r53_api(transport).list_hosted_zones_by_name("example.com.", 1)
     _, url, _, _ = transport.only
     assert url == (
