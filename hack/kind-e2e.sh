@@ -123,8 +123,12 @@ if [ "${DRY_RUN}" != "1" ]; then
 fi
 
 cd "${REPO_ROOT}"
+# E2E_KIND_SOAK is forwarded EXPLICITLY (it would propagate through
+# the environment anyway) so the DRY_RUN audit and its unit tier
+# render the soak leg's plumbing instead of relying on inheritance
 run env \
   E2E_KIND=1 \
+  E2E_KIND_SOAK="${E2E_KIND_SOAK:-0}" \
   KUBECONFIG="${KUBECONFIG_FILE}" \
   E2E_WEBHOOK_URL="https://${HOST_IP}:${WEBHOOK_PORT}" \
   E2E_WEBHOOK_CERT="${WORKDIR}/webhook.crt" \

@@ -149,6 +149,7 @@ class TestDryRunFlow:
         )
         for var in (
             "E2E_KIND=1",
+            "E2E_KIND_SOAK=0",  # off unless the caller opts in
             "KUBECONFIG=",
             "E2E_WEBHOOK_URL=https://<docker-network-gateway>:18443",
             "E2E_WEBHOOK_CERT=",
@@ -196,3 +197,18 @@ class TestEnvOverrides:
         result = run_script(shim_path, DRY_RUN="1", KEEP_CLUSTER="1")
         assert result.returncode == 0, result.stderr
         assert "kind delete cluster" not in result.stdout
+
+    def test_soak_leg_plumbs_to_pytest_tier(self, shim_path):
+        """The CI matrix runs E2E_KIND_SOAK=1 HELM_STAGE=1
+        (.github/workflows/e2e.yml): under DRY_RUN the exact soak
+        plumbing the apiserver-restart tier keys on
+        (tests/test_kind_e2e.py:559) must render in the pytest env."""
+        result = run_script(shim_path, DRY_RUN="1", E2E_KIND_SOAK="1", HELM_STAGE="1")
+        assert result.returncode == 0, result.stderr
+        pytest_line = next(
+            line for line in result.stdout.splitlines()
+            if "python -m pytest tests/test_kind_e2e.py" in line
+        )
+        assert "E2E_KIND_SOAK=1" in pytest_line
+        # and the helm stage still renders downstream of it
+        assert "HELM_STAGE PASSED" in result.stdout
