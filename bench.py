@@ -254,9 +254,13 @@ class ShapedAWS(FakeAWSBackend):
         # a 1000-accelerator fleet runs with raised service quotas in
         # real accounts too; every other documented invariant (name
         # shapes, port ranges, per-listener/group quotas, change-batch
-        # limits) stays enforced at AWS defaults
+        # limits) stays enforced at AWS defaults.  Callers MUST size
+        # quota_accelerators from their own fleet (for_fleet below):
+        # an env-derived default once sat BELOW the tuned fleet's need
+        # when the smoke knobs shrank N_BASELINE/DRIFT_N, wedging the
+        # run in permanent quota retries.
         kwargs.setdefault(
-            "quota_accelerators", N_SERVICES + N_BASELINE + DRIFT_N + 100
+            "quota_accelerators", N_SERVICES + N_BASELINE + DRIFT_N + 400
         )
         self.shaping_enabled = True
         self.counting_enabled = True
@@ -484,6 +488,23 @@ def _ops_delta(before: dict[str, int], after: dict[str, int]) -> dict[str, int]:
     }
 
 
+def fleet_progress(
+    aws: "ShapedAWS",
+    cluster: FakeCluster,
+    zones: list,
+    binding_keys: list[tuple[str, str]],
+) -> tuple[int, int, int]:
+    """(accelerators, records, bound bindings) — the convergence
+    odometer."""
+    bound = sum(
+        1
+        for ns, name in binding_keys
+        if len(cluster.get("EndpointGroupBinding", ns, name).status.endpoint_ids) == 1
+    )
+    records = sum(len(aws.records_in_zone(z.id)) for z in zones)
+    return len(aws.all_accelerator_arns()), records, bound
+
+
 def fleet_converged(
     aws: "ShapedAWS",
     cluster: FakeCluster,
@@ -496,16 +517,36 @@ def fleet_converged(
     """The ONE convergence criterion every phase shares: all
     accelerator chains up, every TXT+A pair written, every binding
     bound to exactly one endpoint."""
-    if len(aws.all_accelerator_arns()) < base_accels + n + n_ing:
-        return False
-    records = sum(len(aws.records_in_zone(z.id)) for z in zones)
-    if records < 2 * (n + n_ing):
-        return False
-    for ns, name in binding_keys:
-        obj = cluster.get("EndpointGroupBinding", ns, name)
-        if len(obj.status.endpoint_ids) != 1:
-            return False
-    return True
+    accels, records, bound = fleet_progress(aws, cluster, zones, binding_keys)
+    return (
+        accels >= base_accels + n + n_ing
+        and records >= 2 * (n + n_ing)
+        and bound == len(binding_keys)
+    )
+
+
+def wait_converged(
+    converged, progress, deadline: float, stall_after: float = 120.0
+) -> bool:
+    """Poll until converged.  A frozen progress odometer for
+    ``stall_after`` seconds means the fleet is WEDGED (e.g. an item
+    stuck in permanent retries) — fail loudly with the odometer
+    instead of burning the whole deadline looking alive."""
+    last = progress()
+    last_change = time.monotonic()
+    while time.monotonic() < deadline:
+        if converged():
+            return True
+        cur = progress()
+        if cur != last:
+            last, last_change = cur, time.monotonic()
+        elif time.monotonic() - last_change > stall_after:
+            raise SystemExit(
+                f"benchmark stalled: progress (accelerators, records, bound)="
+                f"{cur!r} frozen for {stall_after:.0f}s"
+            )
+        time.sleep(0.1)
+    return False
 
 
 # ---------------------------------------------------------------------------
@@ -529,7 +570,9 @@ def run_convergence(
     n_ing, n_egb = scaled_counts(n)
     n_objects = n + n_ing + n_egb
     cluster = FakeCluster()
-    aws = ShapedAWS()
+    # accelerators this run creates: n Services + n_ing Ingresses by
+    # the controllers, plus n_egb out-of-band chains in prepare_aws
+    aws = ShapedAWS(quota_accelerators=n + n_ing + n_egb + 50)
     cache = DiscoveryCache(ttl=cache_ttl) if cache_ttl > 0 else None
     zone_cache = HostedZoneCache(ttl=zone_cache_ttl) if zone_cache_ttl > 0 else None
     zones, group_arns = prepare_aws(aws, n, n_ing, n_egb)
@@ -582,17 +625,16 @@ def run_convergence(
                 aws, cluster, zones, binding_keys, base_accels, n, n_ing
             )
 
-        while time.monotonic() < deadline:
-            if converged():
-                break
-            time.sleep(0.1)
+        done = wait_converged(
+            converged, lambda: fleet_progress(aws, cluster, zones, binding_keys), deadline
+        )
         elapsed = time.monotonic() - start
-        if not converged():
-            done = len(aws.all_accelerator_arns()) - base_accels
-            records = sum(len(aws.records_in_zone(z.id)) for z in zones)
+        if not done:
+            accels, records, bound = fleet_progress(aws, cluster, zones, binding_keys)
             raise SystemExit(
-                f"benchmark did not converge: {done}/{n + n_ing} accelerators, "
-                f"{records}/{2 * (n + n_ing)} records"
+                f"benchmark did not converge: {accels - base_accels}/{n + n_ing} "
+                f"accelerators, {records}/{2 * (n + n_ing)} records, "
+                f"{bound}/{len(binding_keys)} bound"
             )
 
         # convergence-phase ops only: churn and the steady window keep
@@ -624,19 +666,25 @@ def run_convergence(
                 # this framework and the reference skip equal resync
                 # updates (reference globalaccelerator/controller.go:
                 # 100-102 reflect.DeepEqual).  EndpointGroupBindings are
-                # NOT: the reference's EGB handler enqueues resyncs
-                # unconditionally (endpointgroupbinding/controller.go:
-                # 84-94) and its reconcile resolves serviceRef->LB ARNs
-                # BEFORE the ObservedGeneration early return
-                # (reconcile.go:112-157), so a converged fleet pays one
-                # DescribeLoadBalancers per binding per resync — exact
-                # parity, measured here as n_bindings calls per window
+                # NOT: the EGB handler enqueues resyncs unconditionally
+                # (endpointgroupbinding/controller.go:84-94) and the
+                # reconcile resolves serviceRef->LB ARNs BEFORE the
+                # ObservedGeneration early return (reconcile.go:112-157)
+                # — one DescribeLoadBalancers per binding per resync.
+                # That read is LOAD-BEARING, not waste: the EGB
+                # controller watches only bindings (no Service/Ingress
+                # event handlers — listers only), so the resync
+                # re-resolution is the ONLY path that propagates a
+                # referenced Service's changed LB hostname into the
+                # binding.  Exact parity, measured here as n_bindings
+                # calls per window.
                 "note": (
                     "converged Services/Ingresses are quiescent (equal resync "
                     "updates skipped, parity: globalaccelerator/controller.go:100-102); "
                     "each EndpointGroupBinding pays 1 DescribeLoadBalancers per "
-                    "resync (parity: endpointgroupbinding/controller.go:84-94 + "
-                    "reconcile.go:112-157 resolve refs before the early return)"
+                    "resync — the load-bearing ref re-resolution that propagates "
+                    "referenced-Service LB changes (the EGB controller has no "
+                    "Service watch; parity: endpointgroupbinding/controller.go:84-94)"
                 ),
             }
     finally:
@@ -808,7 +856,7 @@ def run_drift_tick(n: int, workers: int) -> dict:
     families of (calls - burst) / rate."""
     n_ing, n_egb = scaled_counts(n)
     cluster = FakeCluster()
-    aws = ShapedAWS()
+    aws = ShapedAWS(quota_accelerators=n + n_ing + n_egb + 50)
     cache = DiscoveryCache(ttl=30.0)
     zone_cache = HostedZoneCache(ttl=60.0)
     zones, group_arns = prepare_aws(aws, n, n_ing, n_egb)
@@ -853,11 +901,9 @@ def run_drift_tick(n: int, workers: int) -> dict:
                 aws, cluster, zones, binding_keys, base_accels, n, n_ing
             )
 
-        while time.monotonic() < deadline:
-            if converged():
-                break
-            time.sleep(0.1)
-        if not converged():
+        if not wait_converged(
+            converged, lambda: fleet_progress(aws, cluster, zones, binding_keys), deadline
+        ):
             raise SystemExit("drift-tick phase: fleet did not converge")
 
         quiet_need = 1.5

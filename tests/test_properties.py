@@ -1,0 +1,335 @@
+"""Property-based tier (hypothesis): invariants the table-driven
+tests can't sweep exhaustively.
+
+The reference's contract surfaces with *unbounded input spaces* —
+wire serialization of arbitrary objects, hostname reverse-engineering
+of arbitrary strings, SigV4 canonicalization of arbitrary header
+sets, queue semantics under arbitrary op sequences — get randomized
+sweeps here on every ``make test``.  Each property is an invariant
+the rest of the framework silently relies on:
+
+- serde round-trips losslessly and ignores unknown keys (the CRD
+  wire-compatibility contract, SURVEY.md §2 row 16/17);
+- the LB hostname parser recovers (name, region) from every valid
+  hostname shape and raises ONLY ValueError on garbage (reference
+  ``load_balancer.go:32-98`` — a stray exception type would escape
+  the controllers' ValueError handling);
+- SigV4 signatures are invariant to header order and name casing
+  (AWS canonicalization, pinned by vectors in
+  ``test_sigv4_aws_vectors.py`` — this sweeps the space between them);
+- the workqueue's dedup/processing-exclusion semantics (client-go's
+  Type contract) hold under arbitrary add/get/done interleavings;
+- the accelerator-name clamp is total, deterministic, and bounded.
+"""
+
+from __future__ import annotations
+
+import datetime
+from types import SimpleNamespace
+
+import pytest
+from hypothesis import HealthCheck, assume, given, settings, strategies as st
+
+from agac_tpu.apis.endpointgroupbinding import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    EndpointGroupBindingStatus,
+    IngressReference,
+    ServiceReference,
+)
+from agac_tpu.cloudprovider.aws.driver import (
+    accelerator_name,
+    parent_domain,
+    replace_wildcards,
+)
+from agac_tpu.cloudprovider.aws.load_balancer import get_lb_name_from_hostname
+from agac_tpu.cloudprovider.aws.sigv4 import Credentials, sign_request
+from agac_tpu.cluster.objects import ObjectMeta
+from agac_tpu.cluster.serde import from_wire, to_wire
+from agac_tpu.reconcile import RateLimitingQueue
+
+# ---------------------------------------------------------------------------
+# serde round trip
+# ---------------------------------------------------------------------------
+
+IDENT = st.text(alphabet="abcdefghijklmnopqrstuvwxyz0123456789-.", min_size=1, max_size=20)
+FREE_TEXT = st.text(max_size=30)  # arbitrary unicode values
+STR_DICT = st.dictionaries(IDENT, FREE_TEXT, max_size=4)
+
+METAS = st.builds(
+    ObjectMeta,
+    name=IDENT,
+    namespace=IDENT,
+    uid=st.text(max_size=12),
+    resource_version=st.text(alphabet="0123456789", max_size=6),
+    generation=st.integers(min_value=0, max_value=10**6),
+    creation_timestamp=st.none() | st.text(max_size=24),
+    deletion_timestamp=st.none() | st.text(max_size=24),
+    annotations=STR_DICT,
+    labels=STR_DICT,
+    finalizers=st.lists(IDENT, max_size=3),
+)
+
+SPECS = st.builds(
+    EndpointGroupBindingSpec,
+    endpoint_group_arn=FREE_TEXT,
+    client_ip_preservation=st.booleans(),
+    weight=st.none() | st.integers(min_value=0, max_value=255),
+    service_ref=st.none() | st.builds(ServiceReference, name=IDENT),
+    ingress_ref=st.none() | st.builds(IngressReference, name=IDENT),
+)
+
+STATUSES = st.builds(
+    EndpointGroupBindingStatus,
+    endpoint_ids=st.lists(FREE_TEXT, max_size=4),
+    observed_generation=st.integers(min_value=0, max_value=10**6),
+)
+
+BINDINGS = st.builds(
+    EndpointGroupBinding, metadata=METAS, spec=SPECS, status=STATUSES
+)
+
+
+@given(BINDINGS)
+def test_serde_round_trip_is_lossless(obj):
+    assert from_wire(EndpointGroupBinding, to_wire(obj)) == obj
+
+
+@given(BINDINGS, st.dictionaries(st.text(min_size=1, max_size=10), FREE_TEXT, max_size=3))
+def test_serde_ignores_unknown_wire_keys(obj, extra):
+    """Forward compatibility: unknown keys (a NEWER server's fields)
+    must not break decode or leak into the object."""
+    wire = to_wire(obj)
+    known = set(wire)
+    wire.update({k: v for k, v in extra.items() if k not in known})
+    assert from_wire(EndpointGroupBinding, wire) == obj
+
+
+# ---------------------------------------------------------------------------
+# LB hostname parser
+# ---------------------------------------------------------------------------
+
+LB_NAME = st.from_regex(r"[a-z0-9][a-z0-9-]{0,18}", fullmatch=True)
+LB_HASH = st.from_regex(r"[a-z0-9]{4,16}", fullmatch=True)
+REGION = st.from_regex(r"[a-z]{2}-[a-z]{4,9}-[1-9]", fullmatch=True)
+
+
+@given(LB_NAME, LB_HASH, REGION)
+def test_public_alb_hostname_round_trips(name, lb_hash, region):
+    assume(not name.startswith("internal-"))
+    hostname = f"{name}-{lb_hash}.{region}.elb.amazonaws.com"
+    assert get_lb_name_from_hostname(hostname) == (name, region)
+
+
+@given(LB_NAME, LB_HASH, REGION)
+def test_internal_alb_hostname_round_trips(name, lb_hash, region):
+    hostname = f"internal-{name}-{lb_hash}.{region}.elb.amazonaws.com"
+    assert get_lb_name_from_hostname(hostname) == (name, region)
+
+
+@given(LB_NAME, LB_HASH, REGION)
+def test_nlb_hostname_round_trips(name, lb_hash, region):
+    hostname = f"{name}-{lb_hash}.elb.{region}.amazonaws.com"
+    assert get_lb_name_from_hostname(hostname) == (name, region)
+
+
+@given(st.text(max_size=60))
+def test_parser_raises_only_valueerror_on_garbage(hostname):
+    """The controllers catch ValueError and emit a permanent-failure
+    Event; any OTHER exception type would crash into the retry loop."""
+    try:
+        name, region = get_lb_name_from_hostname(hostname)
+    except ValueError:
+        return
+    assert isinstance(name, str) and isinstance(region, str)
+
+
+# ---------------------------------------------------------------------------
+# SigV4 canonicalization
+# ---------------------------------------------------------------------------
+
+CREDS = Credentials("AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY")
+NOW = datetime.datetime(2015, 8, 30, 12, 36, 0, tzinfo=datetime.timezone.utc)
+HEADER_NAME = st.from_regex(r"X-[A-Za-z][A-Za-z0-9-]{0,10}", fullmatch=True)
+HEADER_VALUE = st.text(
+    alphabet=st.characters(min_codepoint=33, max_codepoint=126), min_size=1, max_size=12
+)
+
+
+@given(st.dictionaries(HEADER_NAME, HEADER_VALUE, max_size=4), st.randoms())
+@settings(suppress_health_check=[HealthCheck.too_slow])
+def test_sigv4_signature_invariant_to_header_order_and_case(headers, rnd):
+    """AWS canonicalizes headers (lowercase, sorted) before signing:
+    the signature must not depend on dict order or name casing."""
+    assume(len({k.lower() for k in headers}) == len(headers))
+    base = sign_request(
+        "POST", "https://example.amazonaws.com/", dict(headers), b"body",
+        "service", "us-east-1", CREDS, now=NOW,
+    )
+    items = list(headers.items())
+    rnd.shuffle(items)
+    recased = {
+        "".join(c.upper() if rnd.random() < 0.5 else c.lower() for c in k): v
+        for k, v in items
+    }
+    permuted = sign_request(
+        "POST", "https://example.amazonaws.com/", recased, b"body",
+        "service", "us-east-1", CREDS, now=NOW,
+    )
+    assert base["Authorization"] == permuted["Authorization"]
+
+
+# ---------------------------------------------------------------------------
+# workqueue semantics
+# ---------------------------------------------------------------------------
+
+# each example spins up a queue (one daemon waker thread): keep the
+# example count bounded so the tier stays fast
+QUEUE_SETTINGS = settings(
+    max_examples=25, suppress_health_check=[HealthCheck.too_slow], deadline=None
+)
+
+
+@given(st.lists(st.sampled_from("abcde"), min_size=1, max_size=40))
+@QUEUE_SETTINGS
+def test_queue_dedups_and_delivers_each_key_once(keys):
+    queue = RateLimitingQueue(name="prop-dedup")
+    try:
+        for key in keys:
+            queue.add(key)
+        assert len(queue) <= len(set(keys))
+        delivered = []
+        while len(queue):
+            item, shutdown = queue.get(timeout=1.0)
+            assert not shutdown
+            delivered.append(item)
+            queue.done(item)
+        assert sorted(delivered) == sorted(set(keys))
+    finally:
+        queue.shutdown()
+
+
+@given(
+    st.lists(st.sampled_from(["add-a", "add-b", "get", "done"]), min_size=1, max_size=60)
+)
+@QUEUE_SETTINGS
+def test_no_key_is_processed_by_two_workers(ops):
+    """client-go's Type contract: an item being processed is never
+    handed out again until done(); a re-add during processing means
+    exactly one more delivery afterwards."""
+    queue = RateLimitingQueue(name="prop-excl")
+    in_flight: list[str] = []
+    try:
+        for op in ops:
+            if op.startswith("add-"):
+                queue.add(op[-1])
+            elif op == "get":
+                item, _ = queue.get(timeout=0.05)
+                if item is not None:
+                    assert item not in in_flight, "item handed to two workers"
+                    in_flight.append(item)
+            elif op == "done" and in_flight:
+                queue.done(in_flight.pop(0))
+    finally:
+        queue.shutdown()
+
+
+@given(st.sampled_from("ab"), st.integers(min_value=1, max_value=5))
+@QUEUE_SETTINGS
+def test_readd_while_processing_delivers_exactly_once_more(key, readds):
+    queue = RateLimitingQueue(name="prop-readd")
+    try:
+        queue.add(key)
+        item, _ = queue.get(timeout=1.0)
+        assert item == key
+        for _ in range(readds):
+            queue.add(key)  # dirty while processing: not ready yet
+        assert len(queue) == 0
+        queue.done(key)  # dirty -> requeued once
+        item, _ = queue.get(timeout=1.0)
+        assert item == key
+        queue.done(key)
+        assert len(queue) == 0
+    finally:
+        queue.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# small total functions
+# ---------------------------------------------------------------------------
+
+
+@given(IDENT, IDENT, st.text(min_size=1, max_size=300))
+def test_accelerator_name_clamp_is_total_bounded_deterministic(resource, ns, name):
+    obj = SimpleNamespace(
+        metadata=SimpleNamespace(namespace=ns, name=name, annotations={})
+    )
+    first = accelerator_name(resource, obj)
+    assert accelerator_name(resource, obj) == first
+    assert 0 < len(first) <= 64
+    raw = f"{resource}-{ns}-{name}"
+    if len(raw) <= 64:
+        assert first == raw
+
+
+# ---------------------------------------------------------------------------
+# webhook robustness
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def webhook_url():
+    import threading
+
+    from agac_tpu.webhook import make_server
+
+    srv = make_server(0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/validate-endpointgroupbinding"
+    srv.shutdown()
+    srv.server_close()
+
+
+@given(st.binary(max_size=300))
+@settings(
+    max_examples=50, deadline=None,
+    suppress_health_check=[HealthCheck.function_scoped_fixture, HealthCheck.too_slow],
+)
+def test_webhook_never_5xxs_on_garbage_bodies(webhook_url, body):
+    """The apiserver calls this endpoint with failurePolicy=Fail: a
+    5xx (an unhandled exception) blocks ALL binding writes cluster-
+    wide.  Arbitrary junk must map to a 4xx denial or a parsed 200,
+    never a server error."""
+    import urllib.error
+    import urllib.request
+
+    request = urllib.request.Request(
+        webhook_url,
+        data=body,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=5) as response:
+            status = response.status
+    except urllib.error.HTTPError as err:
+        status = err.code
+    assert status < 500, f"webhook 5xx'd on garbage body: {status}"
+
+
+@given(st.text(alphabet="abcdef.-", max_size=40))
+def test_parent_domain_walk_terminates(hostname):
+    steps = 0
+    while hostname:
+        hostname = parent_domain(hostname)
+        steps += 1
+        assert steps <= 41, "parent-domain walk did not shrink"
+
+
+@given(st.text(max_size=30))
+def test_replace_wildcards_replaces_at_most_first_escape(s):
+    out = replace_wildcards(s)
+    assert out.count("\\052") == max(0, s.count("\\052") - 1)
+    if "\\052" not in s:
+        assert out == s
