@@ -64,6 +64,15 @@ e2e-kind:
 e2e-kind-smoke:
 	E2E_KIND=smoke $(PYTHON) -m pytest tests/test_kind_e2e.py -q
 
+# Controller invariant linter (agac_tpu/analysis/): AST rules for the
+# correctness classes ruff can't see — raw backend calls from
+# controllers, bare lock acquire, blocking reconcile handlers, Result
+# fall-throughs, module-level imports of deps CI never installs.
+# Stdlib-only; CI runs it as the `invariants` job.
+.PHONY: lint-invariants
+lint-invariants:
+	$(PYTHON) -m agac_tpu.analysis.lint agac_tpu tests bench.py
+
 .PHONY: bench
 bench:
 	$(PYTHON) bench.py

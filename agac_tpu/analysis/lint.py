@@ -1,0 +1,180 @@
+"""Invariant linter CLI/driver: ``python -m agac_tpu.analysis.lint``.
+
+Walks the given files/packages, runs every registered rule
+(``rules.RULES``) over each module's AST, honors inline suppressions,
+and exits non-zero on any violation.  Stdlib-only by design — the CI
+``invariants`` job runs it on a bare checkout.
+
+Usage::
+
+    python -m agac_tpu.analysis.lint agac_tpu tests bench.py
+
+The CI-installed dependency set (for ``unguarded-optional-import``) is
+parsed from ``pip install`` lines across ``.github/workflows/*.yml``
+of the repo containing the first lint target; pass ``--workflows-dir``
+to point elsewhere, or ``--installed name,name`` to pin the set.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Iterable, Optional
+
+from .rules import RULES, LintContext, Violation, apply_suppressions
+
+# pip "project name" -> import name, for the handful of deps whose
+# names differ; everything else maps to itself (lowercased, - -> _)
+_PIP_IMPORT_NAMES = {
+    "pyyaml": "yaml",
+    "pillow": "PIL",
+    "beautifulsoup4": "bs4",
+}
+
+_PIP_LINE_RE = re.compile(r"pip3?\s+install\s+(.+)$")
+
+
+def parse_ci_installed(workflows_dir: Path) -> frozenset[str]:
+    """Import names installed by any `pip install` line in any workflow."""
+    installed: set[str] = set()
+    if not workflows_dir.is_dir():
+        return frozenset()
+    for wf in sorted(workflows_dir.glob("*.yml")) + sorted(workflows_dir.glob("*.yaml")):
+        for line in wf.read_text().splitlines():
+            m = _PIP_LINE_RE.search(line)
+            if not m:
+                continue
+            for token in m.group(1).split():
+                if token.startswith("-"):
+                    continue  # flags (-e, --upgrade, -r ...)
+                # strip extras and version specifiers: pkg[x]>=1.2
+                name = re.split(r"[\[<>=!~;]", token, 1)[0].strip()
+                if not name:
+                    continue
+                key = name.lower()
+                installed.add(_PIP_IMPORT_NAMES.get(key, key.replace("-", "_")))
+    return frozenset(installed)
+
+
+def iter_python_files(targets: Iterable[Path]) -> Iterable[Path]:
+    for target in targets:
+        if target.is_file() and target.suffix == ".py":
+            yield target
+        elif target.is_dir():
+            for path in sorted(target.rglob("*.py")):
+                if any(part.startswith(".") or part == "__pycache__" for part in path.parts):
+                    continue
+                yield path
+
+
+def lint_source(
+    source: str,
+    path: Path,
+    ci_installed: frozenset[str],
+    first_party: Optional[frozenset[str]] = None,
+) -> list[Violation]:
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as err:
+        return [
+            Violation("syntax-error", str(path), err.lineno or 1, str(err.msg))
+        ]
+    ctx = LintContext(
+        path=path,
+        source_lines=source.splitlines(),
+        ci_installed=ci_installed,
+    )
+    if first_party is not None:
+        ctx.first_party = first_party
+    violations: list[Violation] = []
+    for rule in RULES:
+        violations.extend(rule.check(tree, ctx))
+    kept, suppression_errors = apply_suppressions(violations, ctx)
+    return sorted(
+        kept + suppression_errors, key=lambda v: (v.path, v.line, v.rule)
+    )
+
+
+def lint_paths(
+    targets: Iterable[Path],
+    workflows_dir: Optional[Path] = None,
+    ci_installed: Optional[frozenset[str]] = None,
+) -> list[Violation]:
+    targets = [Path(t) for t in targets]
+    if ci_installed is None:
+        if workflows_dir is None:
+            root = _find_repo_root(targets)
+            workflows_dir = root / ".github" / "workflows"
+        ci_installed = parse_ci_installed(workflows_dir)
+    violations: list[Violation] = []
+    for path in iter_python_files(targets):
+        violations.extend(lint_source(path.read_text(), path, ci_installed))
+    return violations
+
+
+def _find_repo_root(targets: list[Path]) -> Path:
+    probe = (targets[0] if targets else Path.cwd()).resolve()
+    if probe.is_file():
+        probe = probe.parent
+    for candidate in (probe, *probe.parents):
+        if (candidate / ".github").is_dir() or (candidate / ".git").exists():
+            return candidate
+    return Path.cwd()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="agac-lint", description="controller invariant linter"
+    )
+    parser.add_argument("targets", nargs="*", help="files or package dirs")
+    parser.add_argument(
+        "--workflows-dir",
+        type=Path,
+        default=None,
+        help="where to read CI pip-install lines from",
+    )
+    parser.add_argument(
+        "--installed",
+        default=None,
+        help="comma-separated import names to treat as CI-installed "
+        "(overrides workflow parsing)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule registry and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES:
+            print(f"{rule.id}: {rule.summary}")
+        return 0
+    if not args.targets:
+        parser.error("the following arguments are required: targets")
+
+    ci_installed = (
+        frozenset(n.strip() for n in args.installed.split(",") if n.strip())
+        if args.installed is not None
+        else None
+    )
+    violations = lint_paths(
+        [Path(t) for t in args.targets],
+        workflows_dir=args.workflows_dir,
+        ci_installed=ci_installed,
+    )
+    for violation in violations:
+        print(violation.render())
+    if violations:
+        print(
+            f"\n{len(violations)} invariant violation(s); suppress a "
+            "justified exception with `# agac-lint: ignore[rule] -- reason`",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

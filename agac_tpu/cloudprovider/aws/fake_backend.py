@@ -36,11 +36,11 @@ from __future__ import annotations
 
 import itertools
 import re
-import threading
 import uuid
 from dataclasses import replace
 from typing import Optional
 
+from ...analysis import racecheck
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
 from .errors import (
     AWSAPIError,
@@ -172,7 +172,15 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         quota_tags_per_resource: int = 50,
         quota_changes_per_batch: int = 1000,
     ):
-        self._lock = threading.RLock()
+        # racecheck seam: with the lock-order watchdog enabled (tests)
+        # the backend lock participates in cycle detection and the
+        # shared service tables below become guarded dicts that record
+        # any mutation performed without this lock held — the fake is
+        # hit concurrently by every controller worker plus test-side
+        # tamper threads, exactly the surface Go's -race covered for
+        # the reference.
+        lock = racecheck.make_rlock("fake-backend")
+        self._lock = lock
         self.settle_describes = settle_describes
         self.quota_accelerators = quota_accelerators
         self.quota_listeners_per_accelerator = quota_listeners_per_accelerator
@@ -181,14 +189,18 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         self.quota_endpoints_per_group = quota_endpoints_per_group
         self.quota_tags_per_resource = quota_tags_per_resource
         self.quota_changes_per_batch = quota_changes_per_batch
-        self._accelerators: dict[str, _AcceleratorState] = {}
+        # reads of self.* here would recurse into test subclasses'
+        # __getattribute__ fault hooks before their own __init__ ran —
+        # close over the local ``lock`` instead
+        guard = lambda name: racecheck.guard_dict({}, lock, f"fake-backend.{name}")
+        self._accelerators: dict[str, _AcceleratorState] = guard("_accelerators")
         # listener arn -> (accelerator arn); endpoint groups keyed by arn
-        self._listener_parent: dict[str, str] = {}
-        self._endpoint_groups: dict[str, EndpointGroup] = {}
-        self._eg_parent: dict[str, str] = {}  # eg arn -> listener arn
-        self._load_balancers: dict[str, LoadBalancer] = {}  # name -> LB
-        self._zones: dict[str, HostedZone] = {}  # id -> zone
-        self._records: dict[str, dict[tuple[str, str], ResourceRecordSet]] = {}
+        self._listener_parent: dict[str, str] = guard("_listener_parent")
+        self._endpoint_groups: dict[str, EndpointGroup] = guard("_endpoint_groups")
+        self._eg_parent: dict[str, str] = guard("_eg_parent")  # eg arn -> listener arn
+        self._load_balancers: dict[str, LoadBalancer] = guard("_load_balancers")  # name -> LB
+        self._zones: dict[str, HostedZone] = guard("_zones")  # id -> zone
+        self._records: dict[str, dict[tuple[str, str], ResourceRecordSet]] = guard("_records")
         self._counter = itertools.count(1)
         # call log for assertions ("CreateAccelerator", arn), ...
         self.calls: list[tuple] = []

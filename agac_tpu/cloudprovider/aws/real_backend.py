@@ -250,11 +250,15 @@ def _ga_error(operation: str, status: int, body: bytes) -> AWSAPIError:
         # half-written/garbage error envelope: still a typed error
         # naming the operation, with the body excerpt for diagnosis
         message = body[:200].decode(errors="replace")
+    # the typed not-found returns carry the same operation prefix as
+    # every other GA error — anonymous messages made those two error
+    # classes the only undiagnosable ones (ADVICE r5 #4)
+    prefixed = f"{operation}: {message or f'HTTP {status}'}"
     if code == ERR_LISTENER_NOT_FOUND:
-        return ListenerNotFoundException(message)
+        return ListenerNotFoundException(prefixed)
     if code == ERR_ENDPOINT_GROUP_NOT_FOUND:
-        return EndpointGroupNotFoundException(message)
-    return AWSAPIError(code, f"{operation}: {message or f'HTTP {status}'}")
+        return EndpointGroupNotFoundException(prefixed)
+    return AWSAPIError(code, prefixed)
 
 
 def _accelerator_from_json(data: dict) -> Accelerator:

@@ -30,6 +30,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from .. import klog
+from ..analysis import racecheck
 from ..errors import NotFoundError
 from .client import ClusterClient
 from .objects import meta_namespace_key
@@ -57,7 +58,10 @@ class SharedInformer:
         self._client = client
         self.kind = kind
         self._resync_period = resync_period
-        self._lock = threading.Lock()
+        # racecheck seam: instrumented when the lock-order watchdog is
+        # enabled — the store lock is acquired from the watch, dispatch
+        # and every controller thread (via lister reads)
+        self._lock = racecheck.make_lock(f"informer.{kind}")
         self._store: dict[str, Any] = {}
         self._handlers: list[_Handler] = []
         self._synced = threading.Event()
@@ -213,7 +217,7 @@ class SharedInformerFactory:
         self._client = client
         self._resync_period = resync_period
         self._informers: dict[str, SharedInformer] = {}
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("informer-factory")
 
     def informer(self, kind: str) -> SharedInformer:
         with self._lock:

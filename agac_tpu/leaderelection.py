@@ -36,6 +36,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from . import klog
+from .analysis import racecheck
 from .cluster import ClusterClient, Lease
 from .cluster.objects import LeaseSpec, ObjectMeta
 from .errors import AlreadyExistsError, ConflictError, NotFoundError
@@ -72,6 +73,9 @@ class LeaderElection:
         # Observed-record tracking (client-go's observedRecord /
         # observedTime): the lease's last-seen content and the local
         # monotonic time at which it was first seen in that state.
+        # The pair is touched from both the acquire loop and the renew
+        # thread, so it gets its own (racecheck-instrumented) lock.
+        self._observed_lock = racecheck.make_lock(f"leaderelection.{name}")
         self._observed_record: Optional[tuple] = None
         self._observed_time: float = 0.0
 
@@ -176,9 +180,11 @@ class LeaderElection:
             lease.spec.acquire_time,
             lease.spec.lease_transitions,
         )
-        if record != self._observed_record:
-            self._observed_record = record
-            self._observed_time = time.monotonic()
+        with self._observed_lock:
+            if record != self._observed_record:
+                self._observed_record = record
+                self._observed_time = time.monotonic()
+            observed_time = self._observed_time
 
         holder = lease.spec.holder_identity or ""
         if holder != self.identity:
@@ -191,7 +197,7 @@ class LeaderElection:
                 duration = (
                     lease.spec.lease_duration_seconds or self.config.lease_duration
                 )
-                if self._observed_time + duration > time.monotonic():
+                if observed_time + duration > time.monotonic():
                     return False, holder  # lease is held and fresh
             lease.spec.lease_transitions += 1
             lease.spec.acquire_time = now

@@ -26,6 +26,8 @@ import time
 from collections import deque
 from typing import Any, Hashable, Optional
 
+from ..analysis import racecheck
+
 
 class ItemExponentialFailureRateLimiter:
     """Per-item exponential backoff: base * 2^failures, capped."""
@@ -144,7 +146,10 @@ class RateLimitingQueue:
     def __init__(self, rate_limiter=None, name: str = ""):
         self.name = name
         self._limiter = rate_limiter or default_controller_rate_limiter()
-        self._mutex = threading.Lock()
+        # racecheck seam: a plain Lock unless the lock-order watchdog
+        # is enabled (tests), in which case acquisition order across
+        # the worker/waker/handler threads is recorded and verified
+        self._mutex = racecheck.make_lock(f"workqueue.{name or 'unnamed'}")
         self._ready = threading.Condition(self._mutex)
         self._delay = threading.Condition(self._mutex)
         self._queue: deque[Any] = deque()  # FIFO of items ready to be handed out

@@ -236,6 +236,24 @@ class TokenBucket:
             time.sleep(wait)
 
 
+class _UnshapedView:
+    """A second client handle over the same backend that bypasses the
+    shaping *and* counting interception entirely — for out-of-band
+    verification reads while controller workers are still running.
+    Unlike flipping ``shaping_enabled``/``counting_enabled`` globally,
+    a concurrent background call (e.g. a resync-driven
+    DescribeLoadBalancers) landing mid-verification stays shaped and
+    counted (ADVICE r5 #2)."""
+
+    def __init__(self, backend: "ShapedAWS"):
+        self._backend = backend
+
+    def __getattr__(self, name):
+        # object.__getattribute__ bypasses ShapedAWS.__getattribute__,
+        # returning the plain bound method of the underlying fake
+        return object.__getattribute__(self._backend, name)
+
+
 class ShapedAWS(FakeAWSBackend):
     """FakeAWSBackend with asymmetric per-op latency and per-API-family
     blocking throttle quotas on EVERY operation, plus per-op counters
@@ -245,8 +263,9 @@ class ShapedAWS(FakeAWSBackend):
     keep running (the drift-tick phase measures call counts with
     shaping off), so phases that pre-build fleet state snapshot
     ``op_counts`` and report deltas.  ``counting_enabled`` pauses the
-    counters too, for out-of-band verification reads that are neither
-    fixture nor measured work."""
+    counters too, for out-of-band work that is neither fixture nor
+    measured; prefer ``unshaped()`` for verification reads that run
+    concurrently with live controllers."""
 
     _SHAPED = frozenset(REAL_LATENCY)
 
@@ -278,6 +297,11 @@ class ShapedAWS(FakeAWSBackend):
     def snapshot_counts(self) -> dict[str, int]:
         with self._count_lock:
             return dict(self.op_counts)
+
+    def unshaped(self) -> _UnshapedView:
+        """A handle whose calls are never shaped or counted, leaving
+        the global toggles alone for concurrent controller traffic."""
+        return _UnshapedView(self)
 
     def __getattribute__(self, name):
         attr = super().__getattribute__(name)
@@ -783,29 +807,27 @@ def _run_churn(
     if not churned():
         raise SystemExit("EGB churn did not converge within deadline")
 
-    # verify against AWS with shaping and counting paused so the
-    # check costs neither quota nor measured-call accounting
-    aws.shaping_enabled = False
-    aws.counting_enabled = False
-    try:
-        for k, (ns, name) in enumerate(binding_keys):
-            obj = cluster.get("EndpointGroupBinding", ns, name)
-            group = aws.describe_endpoint_group(obj.spec.endpoint_group_arn)
-            weights = {d.endpoint_id: d.weight for d in group.endpoint_descriptions}
-            bound = obj.status.endpoint_ids[0]
-            if weights.get(bound) != 50:
-                raise SystemExit(
-                    f"churn verification failed: {ns}/{name} bound={bound} weights={weights}"
-                )
-            # the group also holds its pre-existing out-of-band
-            # endpoint, so status ids must be a subset, never equal
-            if not set(obj.status.endpoint_ids) <= set(weights):
-                raise SystemExit(
-                    f"churn verification failed: {ns}/{name} status id not bound in AWS"
-                )
-    finally:
-        aws.shaping_enabled = True
-        aws.counting_enabled = True
+    # verify against AWS through a separate unshaped handle: the check
+    # costs neither quota nor measured-call accounting, while any
+    # background controller call landing in this window (e.g. the
+    # per-binding resync DescribeLoadBalancers) stays shaped and
+    # counted — no global toggle flip (ADVICE r5 #2)
+    raw = aws.unshaped()
+    for k, (ns, name) in enumerate(binding_keys):
+        obj = cluster.get("EndpointGroupBinding", ns, name)
+        group = raw.describe_endpoint_group(obj.spec.endpoint_group_arn)
+        weights = {d.endpoint_id: d.weight for d in group.endpoint_descriptions}
+        bound = obj.status.endpoint_ids[0]
+        if weights.get(bound) != 50:
+            raise SystemExit(
+                f"churn verification failed: {ns}/{name} bound={bound} weights={weights}"
+            )
+        # the group also holds its pre-existing out-of-band
+        # endpoint, so status ids must be a subset, never equal
+        if not set(obj.status.endpoint_ids) <= set(weights):
+            raise SystemExit(
+                f"churn verification failed: {ns}/{name} status id not bound in AWS"
+            )
     return {
         "n_bindings": len(binding_keys),
         "weight_edits": len(binding_keys),
@@ -879,7 +901,14 @@ def run_drift_tick(n: int, workers: int) -> dict:
             drift_resync_period=dormant,
         ),
     )
-    manager = Manager(resync_period=RESYNC_PERIOD)
+    # the informer resync is dormant too (not RESYNC_PERIOD): a 30s
+    # resync firing during the tick drain would attribute its
+    # per-binding DescribeLoadBalancers to the tick counts — the
+    # quiescence bracket (quiet_need=1.5s) is far shorter than the
+    # resync period, so it cannot wait one out (ADVICE r5 #3).
+    # Convergence is watch-driven; the resync safety net is exercised
+    # by the soak/chaos tiers, not this measurement.
+    manager = Manager(resync_period=dormant)
     try:
         manager.run(
             cluster,

@@ -1,0 +1,305 @@
+"""Fixture tests for the controller invariant linter
+(``agac_tpu/analysis/lint.py``): every shipped rule fires exactly once
+on a seeded violation, stays quiet on the compliant twin, and the
+suppression contract (justification mandatory) holds.  The final test
+pins the acceptance bar: the linter runs clean over this repo itself —
+the same invocation as ``make lint-invariants`` and the CI
+``invariants`` job.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import textwrap
+
+from agac_tpu.analysis.lint import (
+    lint_paths,
+    lint_source,
+    parse_ci_installed,
+)
+from agac_tpu.analysis.rules import RULES
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+INSTALLED = frozenset({"yaml", "pytest"})
+
+
+def run(src: str, path: str = "pkg/module.py", installed=INSTALLED):
+    return lint_source(textwrap.dedent(src), pathlib.Path(path), installed)
+
+
+def only(violations, rule):
+    assert [v.rule for v in violations] == [rule], violations
+    return violations[0]
+
+
+# ---------------------------------------------------------------------------
+# raw-backend-call
+# ---------------------------------------------------------------------------
+
+
+class TestRawBackendCall:
+    def test_backend_import_in_controller_fires_once(self):
+        v = only(
+            run(
+                "from agac_tpu.cloudprovider.aws.fake_backend import FakeAWSBackend\n",
+                path="agac_tpu/controllers/bad.py",
+            ),
+            "raw-backend-call",
+        )
+        assert "fake_backend" in v.message and v.line == 1
+
+    def test_raw_handle_op_in_controller_fires_once(self):
+        v = only(
+            run(
+                """
+                def reconcile_thing(cloud, arn) -> "Result":
+                    return cloud.ga.describe_accelerator(arn)
+                """,
+                path="agac_tpu/controllers/bad.py",
+            ),
+            "raw-backend-call",
+        )
+        assert "ga.describe_accelerator" in v.message
+
+    def test_driver_wrapper_call_is_clean(self):
+        # the driver mirrors op names as shaped wrappers; calling the
+        # driver is the sanctioned path
+        assert (
+            run(
+                """
+                def reconcile_thing(cloud, arn) -> "Result":
+                    return cloud.describe_endpoint_group(arn)
+                """,
+                path="agac_tpu/controllers/good.py",
+            )
+            == []
+        )
+
+    def test_rule_is_scoped_to_controllers(self):
+        # tests construct backends directly by design
+        assert (
+            run(
+                "from agac_tpu.cloudprovider.aws.fake_backend import FakeAWSBackend\n",
+                path="tests/test_something.py",
+            )
+            == []
+        )
+
+
+# ---------------------------------------------------------------------------
+# bare-lock-acquire
+# ---------------------------------------------------------------------------
+
+
+class TestBareLockAcquire:
+    def test_bare_acquire_fires_once(self):
+        v = only(
+            run(
+                """
+                def f(self):
+                    self._lock.acquire()
+                    self.n += 1
+                """
+            ),
+            "bare-lock-acquire",
+        )
+        assert "with _lock:" in v.message
+
+    def test_with_statement_is_clean(self):
+        assert (
+            run(
+                """
+                def f(self):
+                    with self._lock:
+                        self.n += 1
+                """
+            )
+            == []
+        )
+
+    def test_non_lockish_receiver_is_clean(self):
+        # TokenBucket.acquire-style blocking facades are not locks
+        assert run("def f(bucket):\n    bucket.acquire()\n") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-reconcile
+# ---------------------------------------------------------------------------
+
+
+class TestBlockingReconcile:
+    def test_sleep_in_process_func_fires_once(self):
+        v = only(
+            run(
+                """
+                import time
+
+                def process_create_or_update(obj):
+                    time.sleep(1.0)
+                    return obj
+                """
+            ),
+            "blocking-reconcile",
+        )
+        assert "process_create_or_update" in v.message
+
+    def test_injected_sleep_seam_is_clean(self):
+        # a deadline-bounded injected sleep (driver pattern) is the fix
+        assert (
+            run(
+                """
+                def process_delete(key, sleep):
+                    sleep(0.1)
+                    return key
+                """
+            )
+            == []
+        )
+
+    def test_sleep_outside_reconcile_is_clean(self):
+        assert run("import time\n\ndef wait_until(p):\n    time.sleep(0.1)\n") == []
+
+
+# ---------------------------------------------------------------------------
+# reconcile-returns-result
+# ---------------------------------------------------------------------------
+
+
+class TestReconcileReturnsResult:
+    def test_fall_through_fires_once(self):
+        v = only(
+            run(
+                """
+                def process_x(key) -> Result:
+                    if key:
+                        return Result()
+                """
+            ),
+            "reconcile-returns-result",
+        )
+        assert "fall off the end" in v.message
+
+    def test_bare_return_fires_once(self):
+        v = only(
+            run(
+                """
+                def process_x(key) -> Result:
+                    if not key:
+                        return
+                    return Result()
+                """
+            ),
+            "reconcile-returns-result",
+        )
+        assert "bare `return`" in v.message
+
+    def test_all_paths_returning_is_clean(self):
+        assert (
+            run(
+                """
+                def process_x(key) -> Result:
+                    try:
+                        if key:
+                            return Result(requeue=True)
+                        return Result()
+                    except ValueError:
+                        raise
+                """
+            )
+            == []
+        )
+
+    def test_unannotated_helper_is_clean(self):
+        assert run("def helper(key):\n    if key:\n        return 1\n") == []
+
+
+# ---------------------------------------------------------------------------
+# unguarded-optional-import
+# ---------------------------------------------------------------------------
+
+
+class TestUnguardedOptionalImport:
+    def test_uninstalled_module_level_import_fires_once(self):
+        v = only(
+            run("import hypothesis\n", installed=frozenset({"pytest"})),
+            "unguarded-optional-import",
+        )
+        assert "hypothesis" in v.message
+
+    def test_ci_installed_import_is_clean(self):
+        assert run("import yaml\nimport pytest\n") == []
+
+    def test_guarded_imports_are_clean(self):
+        assert (
+            run(
+                """
+                try:
+                    import hypothesis
+                except ImportError:
+                    hypothesis = None
+
+                def lazy():
+                    import hypothesis
+                """,
+                installed=frozenset(),
+            )
+            == []
+        )
+
+    def test_stdlib_and_first_party_are_clean(self):
+        assert (
+            run(
+                "import threading\nfrom agac_tpu import klog\nfrom . import x\n",
+                installed=frozenset(),
+            )
+            == []
+        )
+
+
+# ---------------------------------------------------------------------------
+# suppression contract
+# ---------------------------------------------------------------------------
+
+
+class TestSuppression:
+    SRC = "def f(self):\n    self._lock.acquire()  # agac-lint: ignore[bare-lock-acquire]{why}\n"
+
+    def test_justified_suppression_silences_the_rule(self):
+        assert run(self.SRC.format(why=" -- handoff: released by the waker thread")) == []
+
+    def test_suppression_without_justification_is_itself_a_violation(self):
+        v = only(run(self.SRC.format(why="")), "suppression-needs-justification")
+        assert "justification" in v.message
+
+    def test_suppression_for_a_different_rule_does_not_apply(self):
+        src = "def f(self):\n    self._lock.acquire()  # agac-lint: ignore[blocking-reconcile] -- wrong rule\n"
+        only(run(src), "bare-lock-acquire")
+
+
+# ---------------------------------------------------------------------------
+# the repo itself + CI wiring
+# ---------------------------------------------------------------------------
+
+
+def test_rule_registry_ships_the_documented_rules():
+    ids = {r.id for r in RULES}
+    assert ids == {
+        "raw-backend-call",
+        "bare-lock-acquire",
+        "blocking-reconcile",
+        "reconcile-returns-result",
+        "unguarded-optional-import",
+    }
+
+
+def test_parse_ci_installed_reads_workflow_pip_lines():
+    installed = parse_ci_installed(REPO / ".github" / "workflows")
+    # pyyaml maps to its import name; hypothesis is the ADVICE r5 #1 fix
+    assert {"yaml", "pytest", "hypothesis"} <= installed
+
+
+def test_repo_is_invariant_clean():
+    """The acceptance bar: `make lint-invariants` (same targets, same
+    rules) exits clean on this repo."""
+    violations = lint_paths([REPO / "agac_tpu", REPO / "tests", REPO / "bench.py"])
+    assert violations == [], "\n".join(v.render() for v in violations)

@@ -27,7 +27,10 @@ import inspect
 import random
 import threading
 
+import pytest
+
 from agac_tpu import apis
+from agac_tpu.analysis import racecheck
 from agac_tpu.cloudprovider.aws.api import ELBv2API, GlobalAcceleratorAPI, Route53API
 from agac_tpu.cloudprovider.aws.errors import AWSAPIError
 from agac_tpu.cloudprovider.aws.fake_backend import FakeAWSBackend
@@ -148,6 +151,20 @@ def chain_complete(aws, owner: str, lb_hostname: str) -> bool:
             d.endpoint_id for d in groups[0].endpoint_descriptions
         ] == [lb_arn]
     return False
+
+
+@pytest.fixture(autouse=True)
+def _racecheck_watchdog():
+    """Chaos runs under the runtime lock-order/race detector too: fault
+    injection exercises the retry/requeue interleavings where a lock-
+    order inversion or an unlocked fake-backend mutation would actually
+    bite, and the tier fails with the offending stacks if one appears."""
+    watchdog = racecheck.enable()
+    try:
+        yield watchdog
+        watchdog.assert_clean()
+    finally:
+        racecheck.disable()
 
 
 class TestChaosFleet:

@@ -28,6 +28,10 @@ import datetime
 from types import SimpleNamespace
 
 import pytest
+
+# CI installs hypothesis (test.yml, the ADVICE r5 #1 fix); environments
+# without it skip this tier at collection instead of erroring
+pytest.importorskip("hypothesis")
 from hypothesis import HealthCheck, assume, given, settings, strategies as st
 
 from agac_tpu.apis.endpointgroupbinding import (

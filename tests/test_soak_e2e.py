@@ -25,7 +25,10 @@ import random
 import threading
 import time
 
+import pytest
+
 from agac_tpu import apis
+from agac_tpu.analysis import racecheck
 from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
 from agac_tpu.cluster import FakeCluster
 from agac_tpu.manager import ControllerConfig, Manager
@@ -43,6 +46,21 @@ N_SERVICE_SLOTS = 20
 N_INGRESS_SLOTS = 6
 CHURN_OPS = 400
 OWNER_TAG = "aws-global-accelerator-owner"
+
+
+@pytest.fixture(autouse=True)
+def _racecheck_watchdog():
+    """Run the whole soak under the runtime lock-order/race detector:
+    every workqueue mutex, informer store lock and the fake backend's
+    guarded tables are instrumented (they are constructed after
+    ``enable()``), and the tier fails with the offending stacks on any
+    lock-order cycle or unlocked shared-dict mutation."""
+    watchdog = racecheck.enable()
+    try:
+        yield watchdog
+        watchdog.assert_clean()
+    finally:
+        racecheck.disable()
 
 
 class TestSoakChurn:

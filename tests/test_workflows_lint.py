@@ -153,6 +153,39 @@ def test_e2e_matrix_matches_reference_strategy():
     assert all(re.fullmatch(r"1\.\d+\.\d+", v) for v in versions)
 
 
+def test_ci_installs_every_module_level_import(tmp_path):
+    """The ADVICE r5 #1 class of gap: this tier checked that workflow
+    paths and make targets exist but not that CI *installs* what the
+    test modules import at module scope — `hypothesis` shipped
+    imported-but-never-installed and every push would have failed at
+    collection.  The invariant linter's `unguarded-optional-import`
+    rule now closes it; this test keeps the repo-wide run wired into
+    the workflows tier (alongside the CI `invariants` job), and proves
+    the rule still catches a seeded gap against these very workflows.
+    """
+    from agac_tpu.analysis.lint import lint_paths, lint_source, parse_ci_installed
+
+    installed = parse_ci_installed(WORKFLOW_DIR)
+    assert "hypothesis" in installed, (
+        "test.yml must pip-install hypothesis (tests/test_properties.py "
+        "imports it at module scope)"
+    )
+    gaps = [
+        v
+        for v in lint_paths([REPO / "agac_tpu", REPO / "tests", REPO / "bench.py"])
+        if v.rule == "unguarded-optional-import"
+    ]
+    assert gaps == [], "\n".join(v.render() for v in gaps)
+
+    # the rule fires against the real workflow-derived install set
+    seeded = lint_source(
+        "import some_dep_ci_never_installs\n",
+        tmp_path / "mod.py",
+        installed,
+    )
+    assert [v.rule for v in seeded] == ["unguarded-optional-import"]
+
+
 def test_e2e_runs_soak_and_helm_legs():
     """CI runs the full opt-in surface: the soak + helm legs the
     DRY_RUN unit tier (tests/test_kind_script.py) interprets."""
