@@ -23,6 +23,12 @@ stack — the classes ruff's pyflakes-tier cannot express:
   imported at module scope, installed nowhere) breaks collection on
   every push while working locally.  Guard it (function scope /
   try-ImportError / importorskip) or add it to the workflow install.
+- ``drift-read-outside-read-plane`` — driver code may not issue raw
+  per-item ``list_*``/``describe_*`` service reads outside the
+  coalesced read plane's loader/sanctioned functions (ISSUE 2): a
+  stray raw read in an ensure/verify path silently reintroduces the
+  O(N)-calls-per-tick regression the read plane exists to kill, and
+  nothing else fails — the fleet just pays 4x the quota again.
 
 Suppression: append ``# agac-lint: ignore[rule-id] -- justification``
 to the offending line.  The justification is mandatory.
@@ -349,6 +355,88 @@ def check_unguarded_optional_import(
                 f"module-level import of {name!r}, which no CI workflow "
                 "pip-installs; guard it or add it to the install line",
             )
+
+
+# ---------------------------------------------------------------------------
+# drift-read-outside-read-plane
+# ---------------------------------------------------------------------------
+
+# The driver functions sanctioned to issue raw service reads
+# (``self.ga.* / self.elbv2.* / self.route53.*``):
+#
+# - read-plane loaders (single-flight cache fill / verify reads):
+#   the discovery snapshot, the chain lookups `_verified_chain`
+#   composes, the per-zone record drain, the batched LB describe, and
+#   the hosted-zone walks;
+# - teardown and read-modify-write paths that are NOT drift-tick reads:
+#   `_list_related`/`_delete_accelerator` (cleanup orchestration) and
+#   `update_endpoint_weight` (full-set weight write needs the current
+#   set);
+# - `describe_endpoint_group`: the EndpointGroupBinding verify read —
+#   one call per binding per tick, keyed by an arn the topology cache
+#   cannot resolve, and GA offers no batch variant.
+#
+# Anything else in driver.py touching a raw list_*/describe_* op is a
+# coalescing regression and must either go through the read plane or
+# carry a justified suppression.
+_READ_PLANE_FUNCS = frozenset(
+    {
+        "_list_accelerators", "_load_discovery_snapshot",
+        "get_listener", "get_endpoint_group",
+        "_fetch_record_sets", "_describe_load_balancers",
+        "_list_all_hosted_zones", "_walk_hosted_zone",
+        "_list_related", "_delete_accelerator",
+        "update_endpoint_weight", "describe_endpoint_group",
+    }
+)
+
+_RAW_READ_OP = re.compile(r"^(list_|describe_)")
+_RAW_SERVICE_HANDLES = frozenset({"ga", "elbv2", "route53"})
+
+
+def _is_aws_driver_module(ctx: LintContext) -> bool:
+    return "cloudprovider" in ctx.path.parts and ctx.path.name == "driver.py"
+
+
+@rule(
+    "drift-read-outside-read-plane",
+    "driver code must route per-item list_*/describe_* service reads "
+    "through the coalesced read plane's loaders, not issue them raw",
+)
+def check_drift_read_outside_read_plane(
+    tree: ast.Module, ctx: LintContext
+) -> Iterator[Violation]:
+    if not _is_aws_driver_module(ctx):
+        return
+    sanctioned: set[int] = set()  # ids of Call nodes inside sanctioned defs
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name in _READ_PLANE_FUNCS:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    sanctioned.add(id(node))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        func = node.func
+        if not _RAW_READ_OP.match(func.attr):
+            continue
+        receiver = _terminal_name(func.value)
+        if receiver not in _RAW_SERVICE_HANDLES:
+            continue
+        if id(node) in sanctioned:
+            continue
+        yield Violation(
+            "drift-read-outside-read-plane",
+            str(ctx.path),
+            node.lineno,
+            f"raw {receiver}.{func.attr}() outside the read plane's "
+            "sanctioned loaders — route it through the coalesced caches "
+            "(AcceleratorTopologyCache / RecordSetCache / "
+            "LoadBalancerCoalescer) or add it to _READ_PLANE_FUNCS with "
+            "justification",
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -36,6 +36,13 @@ from .errors import (
     aws_error_code,
 )
 from .load_balancer import get_lb_name_from_hostname, get_region_from_arn
+from .cache import (
+    AcceleratorTopologyCache,
+    DiscoveryCache,
+    HostedZoneCache,
+    LoadBalancerCoalescer,
+    RecordSetCache,
+)
 from .driver import AWSDriver, Route53OwnerValue
 from .fake_backend import FakeAWSBackend
 
@@ -64,4 +71,9 @@ __all__ = [
     "AWSDriver",
     "Route53OwnerValue",
     "FakeAWSBackend",
+    "DiscoveryCache",
+    "HostedZoneCache",
+    "AcceleratorTopologyCache",
+    "RecordSetCache",
+    "LoadBalancerCoalescer",
 ]

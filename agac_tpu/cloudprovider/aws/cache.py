@@ -1,4 +1,5 @@
-"""Accelerator-discovery cache.
+"""The coalesced read plane: discovery, topology, zone, record-set and
+load-balancer caches.
 
 The reference's hottest path is discovery: every reconcile lists ALL
 accelerators and then calls ListTagsForResource per accelerator —
@@ -41,6 +42,29 @@ Snapshot entries are SHARED between callers, never copied per read:
 list itself is replaced wholesale, never mutated in place.  (A
 defensive deepcopy per hit used to dominate the steady-state reconcile
 profile.)
+
+Beyond the two discovery caches, this module carries the three caches
+of the coalesced VERIFICATION read plane (ISSUE 2): drift ticks used
+to pay O(N) per-object reads — three GA list calls per accelerator,
+one ListResourceRecordSets per hostname against a handful of shared
+zones, and one single-name DescribeLoadBalancers per object.  The
+read plane collapses those to ~one GA read per accelerator, one
+record-set list per hosted zone per tick window, and multi-name
+DescribeLoadBalancers wire calls:
+
+- ``AcceleratorTopologyCache`` — per-accelerator (listener, endpoint
+  group) chains, write-through from the driver's own mutate chains;
+- ``RecordSetCache`` — per-zone record-set snapshots with the change
+  batches the driver commits folded back in;
+- ``LoadBalancerCoalescer`` — a TTL cache plus a gatherer that merges
+  concurrent single-name lookups into one multi-name wire call.
+
+All three are TICK-SCOPED by construction: drift verification exists
+to catch out-of-band tampering, so snapshots are shared within one
+verification round (TTLs well under any sane ``--drift-resync-period``)
+and re-read on the next, and every mismatch/not-found path invalidates
+the same way ``HostedZoneCache`` does.  Local writes are folded or
+write-through applied, never masked.
 """
 
 from __future__ import annotations
@@ -49,7 +73,16 @@ import threading
 import time
 from typing import Callable, Optional
 
-from .types import Accelerator, Tag
+from .errors import ListenerNotFoundException
+from .types import (
+    CHANGE_ACTION_DELETE,
+    Accelerator,
+    EndpointGroup,
+    Listener,
+    LoadBalancer,
+    ResourceRecordSet,
+    Tag,
+)
 
 Snapshot = list[tuple[Accelerator, list[Tag]]]
 
@@ -80,6 +113,11 @@ class HostedZoneCache:
         self._load_event: Optional[threading.Event] = None
         self.hits = 0
         self.misses = 0
+        self.waits = 0  # callers that parked behind another's load
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses, "waits": self.waits}
 
     @staticmethod
     def _build_index(zones: list) -> dict:
@@ -106,6 +144,7 @@ class HostedZoneCache:
                     self.misses += 1
                     break
                 event = self._load_event
+                self.waits += 1
             event.wait()
         try:
             zones = list(loader())
@@ -155,6 +194,11 @@ class DiscoveryCache:
         self._journal: Optional[list] = None
         self.hits = 0
         self.misses = 0
+        self.waits = 0  # callers that parked behind another's load
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses, "waits": self.waits}
 
     def get(self, loader: Callable[[], Snapshot]) -> Snapshot:
         """Return the cached snapshot, loading through ``loader`` when
@@ -177,6 +221,7 @@ class DiscoveryCache:
                     self.misses += 1
                     break
                 event = self._load_event
+                self.waits += 1
             # another worker is already scanning: wait for its result,
             # then re-check (it may have failed — then we lead a retry)
             event.wait()
@@ -258,3 +303,558 @@ class DiscoveryCache:
                     for item in self._snapshot
                     if item[0].accelerator_arn != accelerator_arn
                 ]
+
+
+# ---------------------------------------------------------------------------
+# the coalesced verification read plane (ISSUE 2)
+# ---------------------------------------------------------------------------
+
+
+class _TopologyEntry:
+    """Per-accelerator chain state.  ``listener``/``endpoint_group``
+    are the write-through-maintained data; ``verified_expires`` is the
+    tick-scope window within which the chain counts as verified
+    against AWS; ``full_expires`` bounds how long the write-through
+    listener identity is trusted before a full relist (the detection
+    bound for out-of-band listener *mutation*/addition — deletion is
+    caught every verify, see ``AcceleratorTopologyCache``)."""
+
+    __slots__ = (
+        "listener", "endpoint_group", "verified_expires", "full_expires",
+        "load_event", "journal",
+    )
+
+    def __init__(self):
+        self.listener: Optional[Listener] = None
+        self.endpoint_group: Optional[EndpointGroup] = None
+        self.verified_expires = 0.0
+        self.full_expires = 0.0
+        self.load_event: Optional[threading.Event] = None
+        self.journal: Optional[list] = None
+
+
+class AcceleratorTopologyCache:
+    """Per-accelerator (listener, endpoint group) chains for the drift
+    verify path.
+
+    The uncoalesced verify pays three GA reads per object per tick
+    (ListListeners + ListEndpointGroups + ListTagsForResource).  This
+    cache gets a converged tick down to ONE read per accelerator:
+
+    - tags come from the shared discovery snapshot (the same data the
+      tag-scan ownership match already read — re-listing them live
+      bought nothing but quota spend);
+    - the listener identity is write-through from the driver's own
+      mutate chains (``upsert_listener``), so a cheap verify only has
+      to confirm the chain tail: ONE ``ListEndpointGroups(listener)``
+      call proves the listener still exists (GA raises
+      ListenerNotFound for a deleted parent — and GA cannot delete a
+      listener that still has endpoint groups, so a live endpoint
+      group implies a live listener) AND returns the endpoint set for
+      membership/weight drift checks.
+
+    Freshness contract (tick-scoped):
+
+    - ``verify_ttl`` is the verification dedup window — one cheap
+      verify per accelerator per tick; it must sit well under the
+      drift period (production periods are >= 300 s, default here
+      15 s).  Writes REFRESH DATA but never mark a chain verified:
+      verification means an actual AWS read.
+    - ``full_ttl`` bounds trust in the write-through listener object:
+      past it, the next load is a full relist (ListListeners +
+      ListEndpointGroups), which also catches out-of-band listener
+      port/protocol edits and extra listeners.
+    - any not-found on the verify read falls back to a full load in
+      the same flight; mismatch paths in the driver invalidate.
+
+    Loads are single-flight PER KEY with the same write-journal fold
+    as ``DiscoveryCache``: a write-through landing mid-load repairs
+    the loaded chain, an invalidate/remove poisons the store.
+    """
+
+    def __init__(
+        self,
+        verify_ttl: float = 15.0,
+        full_ttl: float = 900.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._verify_ttl = verify_ttl
+        self._full_ttl = full_ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: dict[str, _TopologyEntry] = {}
+        self.hits = 0       # served from the verified window
+        self.verifies = 0   # cheap single-read verifies
+        self.misses = 0     # full relists
+        self.waits = 0      # callers parked behind another's load
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "verifies": self.verifies,
+                "misses": self.misses,
+                "waits": self.waits,
+                "entries": len(self._entries),
+            }
+
+    def chain(
+        self,
+        arn: str,
+        full_loader: Callable[[str], tuple[Listener, Optional[EndpointGroup]]],
+        verify_loader: Callable[[Listener], Optional[EndpointGroup]],
+    ) -> tuple[Listener, Optional[EndpointGroup]]:
+        """The verified (listener, endpoint_group) chain for ``arn``.
+
+        ``full_loader(arn)`` is the 2-read relist (raises
+        ListenerNotFound when the accelerator has no listener — the
+        caller's create-if-missing path); ``verify_loader(listener)``
+        is the 1-read tail check returning the endpoint group (or
+        None) and raising ListenerNotFound when the cached listener is
+        gone, which falls back to a full load in the same flight."""
+        while True:
+            with self._lock:
+                entry = self._entries.get(arn)
+                now = self._clock()
+                if entry is not None and entry.load_event is None:
+                    if entry.listener is not None and now < entry.verified_expires:
+                        self.hits += 1
+                        return entry.listener, entry.endpoint_group
+                if entry is not None and entry.load_event is not None:
+                    event = entry.load_event
+                    self.waits += 1
+                else:
+                    if entry is None:
+                        entry = self._entries[arn] = _TopologyEntry()
+                    entry.load_event = event = threading.Event()
+                    entry.journal = []
+                    cheap = entry.listener is not None and now < entry.full_expires
+                    cached_listener = entry.listener
+                    break
+            event.wait()
+
+        full = not cheap
+        try:
+            if cheap:
+                self.verifies += 1
+                try:
+                    listener = cached_listener
+                    endpoint_group = verify_loader(cached_listener)
+                except ListenerNotFoundException:
+                    # the write-through listener vanished out-of-band:
+                    # relist in the same flight (it may have been
+                    # recreated with a new arn by another actor)
+                    full = True
+            if full:
+                self.misses += 1
+                listener, endpoint_group = full_loader(arn)
+        except BaseException as err:
+            with self._lock:
+                entry.load_event = None
+                entry.journal = None
+                # no listener at all (accelerator mid-create, chain
+                # torn down, or the cached identity confirmed dead):
+                # drop the entry so the caller's create path re-seeds
+                # it via write-through instead of re-verifying a ghost
+                if self._entries.get(arn) is entry and (
+                    entry.listener is None
+                    or isinstance(err, ListenerNotFoundException)
+                ):
+                    del self._entries[arn]
+            event.set()
+            raise
+
+        with self._lock:
+            journal = entry.journal or []
+            entry.load_event = None
+            entry.journal = None
+            discard = False
+            for op, payload in journal:
+                if op in ("invalidate", "remove"):
+                    discard = True
+                elif op == "listener":
+                    listener = payload
+                elif op == "endpoint_group":
+                    endpoint_group = payload
+            if discard:
+                if self._entries.get(arn) is entry:
+                    del self._entries[arn]
+            else:
+                now = self._clock()
+                entry.listener = listener
+                entry.endpoint_group = endpoint_group
+                entry.verified_expires = now + self._verify_ttl
+                if full:
+                    entry.full_expires = now + self._full_ttl
+        event.set()
+        return listener, endpoint_group
+
+    # -- write-through from the driver's mutate chains ------------------
+    def upsert_listener(self, arn: str, listener: Listener) -> None:
+        """Fold a local listener create/update in.  A fresh entry is
+        seeded with a full-trust window (the writer just created the
+        chain, so the topology is known exactly) but NOT marked
+        verified — drift verification means an actual AWS read, never
+        trusting our own write."""
+        with self._lock:
+            entry = self._entries.get(arn)
+            if entry is None:
+                entry = self._entries[arn] = _TopologyEntry()
+                entry.full_expires = self._clock() + self._full_ttl
+            if entry.journal is not None:
+                entry.journal.append(("listener", listener))
+            entry.listener = listener
+
+    def upsert_endpoint_group(self, arn: str, endpoint_group: EndpointGroup) -> None:
+        with self._lock:
+            entry = self._entries.get(arn)
+            if entry is None:
+                return  # no chain context to attach to
+            if entry.journal is not None:
+                entry.journal.append(("endpoint_group", endpoint_group))
+            entry.endpoint_group = endpoint_group
+
+    def invalidate(self, arn: str) -> None:
+        """External/unknown change to this chain: drop it, and poison
+        any in-flight load so its result is returned but not stored."""
+        with self._lock:
+            entry = self._entries.get(arn)
+            if entry is None:
+                return
+            if entry.journal is not None:
+                entry.journal.append(("invalidate", None))
+            else:
+                del self._entries[arn]
+
+    def remove(self, arn: str) -> None:
+        """The accelerator was deleted locally (same journal semantics
+        as ``invalidate``; kept separate for intent at call sites)."""
+        self.invalidate(arn)
+
+    def invalidate_endpoint_group(self, endpoint_group_arn: str) -> None:
+        """An endpoint-group mutation landed by eg arn (the
+        EndpointGroupBinding paths address groups directly): expire
+        the verification window of whichever chain holds it so the
+        next read re-verifies instead of serving the stale endpoint
+        set.  O(entries) scan — in-memory, and eg mutates are orders
+        rarer than reads."""
+        with self._lock:
+            for entry in self._entries.values():
+                eg = entry.endpoint_group
+                if eg is not None and eg.endpoint_group_arn == endpoint_group_arn:
+                    entry.verified_expires = 0.0
+
+
+def _wire_record_name(name: str) -> str:
+    """Route53 returns names dot-terminated with ``*`` escaped as
+    ``\\052``; snapshot entries must look like API responses so the
+    driver's matching helpers work unchanged.  Idempotent."""
+    if not name.endswith("."):
+        name += "."
+    return name if "\\052" in name else name.replace("*", "\\052", 1)
+
+
+def _wire_record(record: ResourceRecordSet) -> ResourceRecordSet:
+    """A normalized copy of a submitted record set, shaped like the
+    service would return it (wire name, dot-terminated alias target)."""
+    from .types import AliasTarget, ResourceRecord
+
+    alias = record.alias_target
+    if alias is not None:
+        dns = alias.dns_name if alias.dns_name.endswith(".") else alias.dns_name + "."
+        alias = AliasTarget(
+            dns_name=dns,
+            evaluate_target_health=alias.evaluate_target_health,
+            hosted_zone_id=alias.hosted_zone_id,
+        )
+    return ResourceRecordSet(
+        name=_wire_record_name(record.name),
+        type=record.type,
+        ttl=record.ttl,
+        resource_records=[ResourceRecord(r.value) for r in record.resource_records],
+        alias_target=alias,
+    )
+
+
+class RecordSetCache:
+    """Per-hosted-zone record-set snapshots for the Route53 verify and
+    cleanup paths.
+
+    Hostnames cluster onto a handful of shared zones, so the
+    per-object ``ListResourceRecordSets`` drain was the single biggest
+    Route53 read family per drift tick (1,100 calls against ~10 zones
+    in the bench fleet).  One snapshot per zone per tick window
+    collapses that to one list per zone.
+
+    Freshness: tick-scoped TTL (well under the drift period), plus the
+    driver folds every change batch it successfully commits back into
+    the snapshot (``apply_changes``) so a reconcile never acts on its
+    own stale write, and invalidates the zone on InvalidChangeBatch /
+    NoSuchHostedZone — the signatures of a snapshot that lied.  A
+    stale-positive (record actually deleted after the load) is caught
+    on the next tick's reload; a stale-negative CREATE fails loudly at
+    AWS, invalidates, and the backoff retry re-reads — the same repair
+    shape ``HostedZoneCache`` uses.
+
+    Loads are single-flight per zone with the DiscoveryCache journal
+    fold: changes applied while a load is in flight are replayed onto
+    the loaded snapshot before it is stored."""
+
+    def __init__(self, ttl: float = 15.0, clock: Callable[[], float] = time.monotonic):
+        self._ttl = ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        # zone id -> (snapshot, expires) / in-flight (event, journal)
+        self._snapshots: dict[str, tuple[list[ResourceRecordSet], float]] = {}
+        self._loading: dict[str, tuple[threading.Event, list]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.waits = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "waits": self.waits,
+                "zones": len(self._snapshots),
+            }
+
+    def get(
+        self, zone_id: str, loader: Callable[[], list[ResourceRecordSet]]
+    ) -> list[ResourceRecordSet]:
+        while True:
+            with self._lock:
+                cached = self._snapshots.get(zone_id)
+                if cached is not None and self._clock() < cached[1]:
+                    self.hits += 1
+                    return cached[0]
+                in_flight = self._loading.get(zone_id)
+                if in_flight is None:
+                    event = threading.Event()
+                    self._loading[zone_id] = (event, [])
+                    self.misses += 1
+                    break
+                event = in_flight[0]
+                self.waits += 1
+            event.wait()
+        try:
+            snapshot = list(loader())
+        except BaseException:
+            with self._lock:
+                self._loading.pop(zone_id, None)
+            event.set()
+            raise
+        with self._lock:
+            _, journal = self._loading.pop(zone_id, (None, []))
+            discard = False
+            for op, payload in journal:
+                if op == "invalidate":
+                    discard = True
+                else:  # ("changes", list[Change])
+                    snapshot = self._fold_changes(snapshot, payload)
+            if not discard:
+                self._snapshots[zone_id] = (snapshot, self._clock() + self._ttl)
+        event.set()
+        return snapshot
+
+    @staticmethod
+    def _fold_changes(snapshot: list[ResourceRecordSet], changes: list) -> list:
+        """Replay a committed change batch onto a snapshot, returning
+        a NEW list (snapshots are shared, never mutated in place)."""
+        result = list(snapshot)
+        for change in changes:
+            record = _wire_record(change.record_set)
+            key = (record.name, record.type)
+            result = [r for r in result if (r.name, r.type) != key]
+            if change.action != CHANGE_ACTION_DELETE:
+                result.append(record)
+        return result
+
+    def apply_changes(self, zone_id: str, changes: list) -> None:
+        """Fold a change batch this process successfully committed into
+        the zone snapshot (write-through), and journal it into any
+        in-flight load so the loaded snapshot cannot miss it."""
+        with self._lock:
+            in_flight = self._loading.get(zone_id)
+            if in_flight is not None:
+                in_flight[1].append(("changes", changes))
+            cached = self._snapshots.get(zone_id)
+            if cached is not None:
+                self._snapshots[zone_id] = (
+                    self._fold_changes(cached[0], changes), cached[1]
+                )
+
+    def invalidate(self, zone_id: str) -> None:
+        with self._lock:
+            self._snapshots.pop(zone_id, None)
+            in_flight = self._loading.get(zone_id)
+            if in_flight is not None:
+                in_flight[1].append(("invalidate", None))
+
+    def invalidate_all(self) -> None:
+        with self._lock:
+            self._snapshots.clear()
+            for _, journal in self._loading.values():
+                journal.append(("invalidate", None))
+
+
+class _LBBatch:
+    __slots__ = ("names", "event", "results", "error", "closed", "split", "settled")
+
+    def __init__(self):
+        self.names: set[str] = set()
+        self.event = threading.Event()
+        self.results: dict[str, LoadBalancer] = {}
+        self.error: Optional[BaseException] = None
+        self.closed = False
+        # real ELBv2 fails the WHOLE call when any requested name is
+        # missing; a split batch degrades members to single fetches
+        self.split = False
+        # set once the leader recorded an outcome; a wake-up without it
+        # (leader died mid-fetch) degrades joiners to single fetches
+        self.settled = False
+
+
+class LoadBalancerCoalescer:
+    """Batches concurrent single-name ``DescribeLoadBalancers`` lookups
+    into multi-name wire calls behind a short TTL cache.
+
+    Every reconcile of every controller starts with one LB lookup, so
+    a drift tick fires ~N concurrent single-name describes.  The wire
+    protocol already takes up to 20 names per call
+    (``Names.member.N``, real_backend.py) — the first misser of a
+    window becomes the batch leader, waits ``batch_window`` for
+    co-missers, and issues ONE describe for the gathered names; the
+    TTL then shares each result across the controllers that look up
+    the same LB in the same tick (GA + EndpointGroupBinding both
+    resolve ``benchNNNN``-style names).
+
+    Freshness: the TTL is tick-scoped (LB state/dns drift is re-read
+    every round); results are never negatively cached — a name absent
+    from a response returns None to the caller (the driver raises its
+    usual LoadBalancerNotFound) and the next lookup goes to the wire.
+    Real ELBv2 fails an entire multi-name call when ANY name is
+    unknown, so a LoadBalancerNotFound on a multi-name batch degrades
+    that batch to per-name fetches instead of poisoning 19 healthy
+    lookups."""
+
+    # DescribeLoadBalancers accepts at most 20 names per call
+    MAX_BATCH = 20
+
+    def __init__(
+        self,
+        ttl: float = 15.0,
+        batch_window: float = 0.01,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self._ttl = ttl
+        self._batch_window = batch_window
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._cache: dict[str, tuple[LoadBalancer, float]] = {}
+        self._forming: Optional[_LBBatch] = None
+        self.hits = 0
+        self.misses = 0
+        self.waits = 0          # joiners that parked on a leader's batch
+        self.batches = 0        # wire calls issued (incl. split singles)
+        self.batch_sizes: dict[int, int] = {}  # size -> count
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "waits": self.waits,
+                "batches": self.batches,
+                "batch_sizes": dict(sorted(self.batch_sizes.items())),
+            }
+
+    def _record_batch(self, size: int) -> None:
+        self.batches += 1
+        self.batch_sizes[size] = self.batch_sizes.get(size, 0) + 1
+
+    def _store(self, lbs: list[LoadBalancer]) -> None:
+        expires = self._clock() + self._ttl
+        for lb in lbs:
+            self._cache[lb.load_balancer_name] = (lb, expires)
+
+    def get(
+        self, name: str, fetch: Callable[[list[str]], list[LoadBalancer]]
+    ) -> Optional[LoadBalancer]:
+        """The load balancer named ``name``, or None if AWS does not
+        know it.  ``fetch(names)`` is the raw multi-name describe."""
+        with self._lock:
+            cached = self._cache.get(name)
+            if cached is not None and self._clock() < cached[1]:
+                self.hits += 1
+                return cached[0]
+            self.misses += 1
+            batch = self._forming
+            if (
+                batch is not None
+                and not batch.closed
+                and len(batch.names | {name}) <= self.MAX_BATCH
+            ):
+                batch.names.add(name)
+                leader = False
+                self.waits += 1
+            else:
+                batch = _LBBatch()
+                batch.names.add(name)
+                self._forming = batch
+                leader = True
+
+        if leader:
+            try:
+                if self._batch_window > 0:
+                    self._sleep(self._batch_window)  # gather co-missers
+                with self._lock:
+                    batch.closed = True
+                    if self._forming is batch:
+                        self._forming = None
+                    names = sorted(batch.names)
+                try:
+                    found = fetch(names)
+                except Exception as err:
+                    if len(names) > 1 and _is_lb_not_found(err):
+                        # real-AWS all-or-nothing semantics: one unknown
+                        # name failed the whole call — degrade to singles
+                        batch.split = True
+                    else:
+                        batch.error = err
+                else:
+                    with self._lock:
+                        self._store(found)
+                        self._record_batch(len(names))
+                    batch.results = {lb.load_balancer_name: lb for lb in found}
+                batch.settled = True
+            finally:
+                # even a BaseException mid-fetch must wake the joiners
+                # (an unset event would park them forever); an unsettled
+                # wake-up degrades them to their own single fetches
+                if not batch.settled:
+                    batch.split = True
+                batch.event.set()
+        else:
+            batch.event.wait()
+
+        if batch.error is not None:
+            raise batch.error
+        if batch.split:
+            found = fetch([name])  # may raise not-found: caller's contract
+            with self._lock:
+                self._store(found)
+                self._record_batch(1)
+            for lb in found:
+                if lb.load_balancer_name == name:
+                    return lb
+            return None
+        return batch.results.get(name)
+
+
+def _is_lb_not_found(err: BaseException) -> bool:
+    code = getattr(err, "code", "")
+    return isinstance(code, str) and "LoadBalancerNotFound" in code

@@ -303,6 +303,9 @@ class AWSDriver:
         accelerator_missing_retry: float = ACCELERATOR_MISSING_RETRY,
         discovery_cache=None,
         zone_cache=None,
+        topology_cache=None,
+        record_cache=None,
+        lb_coalescer=None,
     ):
         self.ga = ga
         self.elbv2 = elbv2
@@ -319,16 +322,36 @@ class AWSDriver:
         # optional shared HostedZoneCache: short-circuits the 2-probe
         # parent-domain zone walk every Route53 ensure repeats
         self._zone_cache = zone_cache
+        # the coalesced verification read plane (ISSUE 2), all opt-in:
+        # per-accelerator chain verification (AcceleratorTopologyCache),
+        # per-zone record-set snapshots (RecordSetCache), and batched
+        # DescribeLoadBalancers (LoadBalancerCoalescer — must be per
+        # region: a batch goes out through THIS driver's elbv2 handle)
+        self._topology_cache = topology_cache
+        self._record_cache = record_cache
+        self._lb_coalescer = lb_coalescer
 
     # ------------------------------------------------------------------
     # ELBv2
     # ------------------------------------------------------------------
+    def _describe_load_balancers(self, names: list[str]) -> list[LoadBalancer]:
+        """The raw multi-name describe — the read plane's ELBv2 loader
+        (the wire call takes up to 20 names, ``real_backend.py``)."""
+        return self.elbv2.describe_load_balancers(names)
+
     def get_load_balancer(self, name: str) -> LoadBalancer:
         """DescribeLoadBalancers + exact-name match
-        (reference ``load_balancer.go:13-30``)."""
-        for lb in self.elbv2.describe_load_balancers([name]):
-            if lb.load_balancer_name == name:
+        (reference ``load_balancer.go:13-30``).  With the optional
+        coalescer, concurrent lookups gather into one multi-name wire
+        call and the result is shared for the tick-scoped TTL."""
+        if self._lb_coalescer is not None:
+            lb = self._lb_coalescer.get(name, self._describe_load_balancers)
+            if lb is not None:
                 return lb
+        else:
+            for lb in self._describe_load_balancers([name]):
+                if lb.load_balancer_name == name:
+                    return lb
         raise AWSAPIError("LoadBalancerNotFound", f"Could not find LoadBalancer: {name}")
 
     # ------------------------------------------------------------------
@@ -367,7 +390,15 @@ class AWSDriver:
         if self._discovery_cache is not None:
             self._discovery_cache.remove(arn)
 
-    def _list_by_tags(self, want: dict[str, str]) -> list[Accelerator]:
+    def _pairs_by_tags(
+        self, want: dict[str, str]
+    ) -> list[tuple[Accelerator, list[Tag]]]:
+        """Matching (accelerator, tags) pairs from the discovery
+        snapshot.  The tags ride along so the ensure path's
+        accelerator-drift check reads them from the SAME snapshot the
+        ownership match just used instead of a second live
+        ListTagsForResource per object — identical data, one less GA
+        read, staleness bounded by the discovery TTL either way."""
         if self._discovery_cache is not None:
             snapshot = self._discovery_cache.get(self._load_discovery_snapshot)
         else:
@@ -375,13 +406,16 @@ class AWSDriver:
         result = []
         for accelerator, tags in snapshot:
             if tags_contains_all_values(tags, want):
-                result.append(accelerator)
+                result.append((accelerator, tags))
             else:
                 klog.v(4).infof(
                     "Global Accelerator %s does not have match tags",
                     accelerator.accelerator_arn,
                 )
         return result
+
+    def _list_by_tags(self, want: dict[str, str]) -> list[Accelerator]:
+        return [accelerator for accelerator, _ in self._pairs_by_tags(want)]
 
     def list_global_accelerator_by_hostname(
         self, hostname: str, cluster_name: str
@@ -468,10 +502,14 @@ class AWSDriver:
 
         klog.infof("LoadBalancer is %s", lb.load_balancer_arn)
         ns, name = obj.metadata.namespace, obj.metadata.name
-        accelerators = self.list_global_accelerator_by_resource(
-            cluster_name, resource, ns, name
+        pairs = self._pairs_by_tags(
+            {
+                MANAGED_TAG_KEY: "true",
+                OWNER_TAG_KEY: accelerator_owner_tag_value(resource, ns, name),
+                CLUSTER_TAG_KEY: cluster_name,
+            }
         )
-        if not accelerators:
+        if not pairs:
             klog.infof("Creating Global Accelerator for %s", lb.dns_name)
             try:
                 arn = self._create_accelerator_chain(
@@ -487,7 +525,7 @@ class AWSDriver:
                 raise partial.cause
             return arn, True, 0.0
 
-        for accelerator in accelerators:
+        for accelerator, tags in pairs:
             klog.infof(
                 "Updating existing Global Accelerator %s", accelerator.accelerator_arn
             )
@@ -495,13 +533,14 @@ class AWSDriver:
                 resource,
                 obj,
                 accelerator,
+                tags,
                 lb,
                 region,
                 listener_spec,
                 protocol_changed,
                 port_changed,
             )
-        return accelerators[0].accelerator_arn, False, 0.0
+        return pairs[0][0].accelerator_arn, False, 0.0
 
     def _create_accelerator_chain(
         self, resource: str, obj, lb: LoadBalancer, cluster_name: str, region: str, listener_spec
@@ -534,6 +573,7 @@ class AWSDriver:
                 protocol,
                 CLIENT_AFFINITY_NONE,
             )
+            self._topology_upsert_listener(arn, listener)
             klog.infof("Listener is created: %s", listener.listener_arn)
             endpoint_group = self.ga.create_endpoint_group(
                 listener.listener_arn,
@@ -545,6 +585,7 @@ class AWSDriver:
                     )
                 ],
             )
+            self._topology_upsert_endpoint_group(arn, endpoint_group)
             klog.infof(
                 "EndpointGroup is created: %s", endpoint_group.endpoint_group_arn
             )
@@ -557,6 +598,7 @@ class AWSDriver:
         resource: str,
         obj,
         accelerator: Accelerator,
+        tags: list[Tag],
         lb: LoadBalancer,
         region: str,
         listener_spec,
@@ -564,10 +606,13 @@ class AWSDriver:
         port_changed,
     ) -> None:
         """Three-level drift repair with create-if-missing at each
-        level (reference ``global_accelerator.go:288-347``)."""
+        level (reference ``global_accelerator.go:288-347``).  ``tags``
+        is the snapshot tag set that matched this accelerator — the
+        accelerator-level drift check reads it instead of re-listing
+        tags live (see ``_pairs_by_tags``)."""
         ns, name = obj.metadata.namespace, obj.metadata.name
         arn = accelerator.accelerator_arn
-        if self._accelerator_changed(resource, obj, accelerator, lb.dns_name):
+        if self._accelerator_changed(resource, obj, accelerator, tags, lb.dns_name):
             klog.infof("Updating Global Accelerator %s", arn)
             self.ga.update_accelerator(
                 arn, name=accelerator_name(resource, obj), enabled=True
@@ -588,13 +633,15 @@ class AWSDriver:
             self._invalidate_discovery()
 
         try:
-            listener = self.get_listener(arn)
+            listener, endpoint_group = self._verified_chain(arn)
         except ListenerNotFoundException:
             ports, protocol = listener_spec(obj)
             listener = self.ga.create_listener(
                 arn, [PortRange(p, p) for p in ports], protocol, CLIENT_AFFINITY_NONE
             )
+            self._topology_upsert_listener(arn, listener)
             klog.infof("Listener is created: %s", listener.listener_arn)
+            endpoint_group = None
         if protocol_changed(listener, obj) or port_changed(listener, obj):
             klog.infof("Listener is changed, so updating: %s", listener.listener_arn)
             ports, protocol = listener_spec(obj)
@@ -604,10 +651,9 @@ class AWSDriver:
                 protocol,
                 CLIENT_AFFINITY_NONE,
             )
+            self._topology_upsert_listener(arn, listener)
 
-        try:
-            endpoint_group = self.get_endpoint_group(listener.listener_arn)
-        except EndpointGroupNotFoundException:
+        if endpoint_group is None:
             endpoint_group = self.ga.create_endpoint_group(
                 listener.listener_arn,
                 region,
@@ -618,13 +664,14 @@ class AWSDriver:
                     )
                 ],
             )
+            self._topology_upsert_endpoint_group(arn, endpoint_group)
             klog.infof("EndpointGroup is created: %s", endpoint_group.endpoint_group_arn)
-        if not endpoint_contains_lb(endpoint_group, lb):
+        elif not endpoint_contains_lb(endpoint_group, lb):
             klog.infof(
                 "Endpoint Group is changed, so updating: %s",
                 endpoint_group.endpoint_group_arn,
             )
-            self.ga.update_endpoint_group(
+            updated = self.ga.update_endpoint_group(
                 endpoint_group.endpoint_group_arn,
                 [
                     EndpointConfiguration(
@@ -633,23 +680,22 @@ class AWSDriver:
                     )
                 ],
             )
+            self._topology_upsert_endpoint_group(arn, updated)
         klog.infof("All resources are synced: %s", arn)
 
     def _accelerator_changed(
-        self, resource: str, obj, accelerator: Accelerator, hostname: str
+        self, resource: str, obj, accelerator: Accelerator, tags: list[Tag], hostname: str
     ) -> bool:
         """Drift at the accelerator level: disabled, renamed, or
         ownership tags missing (reference ``global_accelerator.go:410-432``;
-        note the cluster tag is not part of this check there either)."""
+        note the cluster tag is not part of this check there either).
+        ``tags`` comes from the discovery snapshot that matched the
+        accelerator (same data, same staleness bound as the ownership
+        match itself — see ``_pairs_by_tags``)."""
         if not accelerator.enabled:
             return True
         if accelerator.name != accelerator_name(resource, obj):
             return True
-        try:
-            tags = self.ga.list_tags_for_resource(accelerator.accelerator_arn)
-        except Exception as err:
-            klog.warning(err)
-            return False
         return not tags_contains_all_values(
             tags,
             {
@@ -659,6 +705,67 @@ class AWSDriver:
                 ),
                 TARGET_HOSTNAME_TAG_KEY: hostname,
             },
+        )
+
+    # ------------------------------------------------------------------
+    # Global Accelerator: chain verification (the coalesced read plane)
+    # ------------------------------------------------------------------
+    def _topology_upsert_listener(self, accelerator_arn: str, listener) -> None:
+        if self._topology_cache is not None:
+            self._topology_cache.upsert_listener(accelerator_arn, listener)
+
+    def _topology_upsert_endpoint_group(self, accelerator_arn: str, endpoint_group) -> None:
+        if self._topology_cache is not None:
+            self._topology_cache.upsert_endpoint_group(accelerator_arn, endpoint_group)
+
+    def _topology_remove(self, accelerator_arn: str) -> None:
+        if self._topology_cache is not None:
+            self._topology_cache.remove(accelerator_arn)
+
+    def _topology_eg_mutated(self, endpoint_group_arn: str) -> None:
+        """An endpoint group was mutated by eg arn (the
+        EndpointGroupBinding paths): expire whatever chain holds it so
+        the next verify re-reads the endpoint set."""
+        if self._topology_cache is not None:
+            self._topology_cache.invalidate_endpoint_group(endpoint_group_arn)
+
+    def _load_chain_full(
+        self, accelerator_arn: str
+    ) -> tuple[Listener, Optional[EndpointGroup]]:
+        """The 2-read full chain relist (read-plane loader): raises
+        ListenerNotFound/TooMany* exactly like the legacy pair of
+        lookups; a missing endpoint group is returned as None (the
+        caller's create-if-missing path)."""
+        listener = self.get_listener(accelerator_arn)
+        try:
+            endpoint_group = self.get_endpoint_group(listener.listener_arn)
+        except EndpointGroupNotFoundException:
+            endpoint_group = None
+        return listener, endpoint_group
+
+    def _verify_chain_live(self, listener: Listener) -> Optional[EndpointGroup]:
+        """The 1-read chain tail verify (read-plane loader): one
+        ListEndpointGroups against the write-through listener proves
+        the listener still exists (GA raises ListenerNotFound for a
+        deleted parent, and a listener with live endpoint groups
+        cannot be deleted) and returns the current endpoint set."""
+        try:
+            return self.get_endpoint_group(listener.listener_arn)
+        except EndpointGroupNotFoundException:
+            return None
+
+    def _verified_chain(
+        self, accelerator_arn: str
+    ) -> tuple[Listener, Optional[EndpointGroup]]:
+        """The (listener, endpoint_group) chain for the ensure/verify
+        path.  Without the topology cache this is the legacy pair of
+        per-object lookups (reference parity); with it, a converged
+        tick costs one GA read per accelerator (see
+        ``AcceleratorTopologyCache``)."""
+        if self._topology_cache is None:
+            return self._load_chain_full(accelerator_arn)
+        return self._topology_cache.chain(
+            accelerator_arn, self._load_chain_full, self._verify_chain_live
         )
 
     # ------------------------------------------------------------------
@@ -697,6 +804,9 @@ class AWSDriver:
     # Global Accelerator: cleanup (reference ``global_accelerator.go:252-286``)
     # ------------------------------------------------------------------
     def cleanup_global_accelerator(self, arn: str) -> None:
+        # the chain is going away: drop its topology entry up front so
+        # a concurrent verify can't serve members mid-teardown
+        self._topology_remove(arn)
         accelerator, listeners, endpoint_groups = self._list_related(arn)
         for endpoint_group in endpoint_groups:
             self.ga.delete_endpoint_group(endpoint_group.endpoint_group_arn)
@@ -801,6 +911,7 @@ class AWSDriver:
         )
         if not added:
             raise AWSAPIError("NoEndpointAdded", "No endpoint is added")
+        self._topology_eg_mutated(endpoint_group.endpoint_group_arn)
         klog.infof("Endpoint is added: %s", added[0].endpoint_id)
         return added[0].endpoint_id, 0.0
 
@@ -808,6 +919,7 @@ class AWSDriver:
         self, endpoint_group: EndpointGroup, endpoint_id: str
     ) -> None:
         self.ga.remove_endpoints(endpoint_group.endpoint_group_arn, [endpoint_id])
+        self._topology_eg_mutated(endpoint_group.endpoint_group_arn)
         klog.infof("Endpoint is removed: %s", endpoint_id)
 
     def update_endpoint_weight(
@@ -826,6 +938,7 @@ class AWSDriver:
             for d in current.endpoint_descriptions
         ]
         self.ga.update_endpoint_group(endpoint_group.endpoint_group_arn, configs)
+        self._topology_eg_mutated(endpoint_group.endpoint_group_arn)
         klog.infof("Endpoint weight is updated: %s", endpoint_id)
 
     # ------------------------------------------------------------------
@@ -896,9 +1009,9 @@ class AWSDriver:
                 hosted_zone, hostname, owner_value, accelerator
             )
         except AWSAPIError as err:
-            if err.code == "NoSuchHostedZone" and self._zone_cache is not None:
+            if err.code == "NoSuchHostedZone":
                 # the zone we RESOLVED vanished mid-ensure (deleted
-                # out-of-band): drop the snapshot so the retry
+                # out-of-band): drop the snapshots so the retry
                 # re-reads.  Scoped here, after resolution succeeded,
                 # on purpose — when get_hosted_zone itself raises (a
                 # hostname matching no zone at all) the live walk was
@@ -906,7 +1019,10 @@ class AWSDriver:
                 # at fault, so a persistently misconfigured object
                 # must not flush the warm snapshot on every backoff
                 # retry.
-                self._zone_cache.invalidate()
+                if self._zone_cache is not None:
+                    self._zone_cache.invalidate()
+                if self._record_cache is not None:
+                    self._record_cache.invalidate(hosted_zone.id)
             raise
 
     def _ensure_route53_in_zone(
@@ -1028,12 +1144,44 @@ class AWSDriver:
                     return zone
             target = parent_domain(target)
 
-    def _list_record_sets(self, hosted_zone_id: str) -> list[ResourceRecordSet]:
+    def _fetch_record_sets(self, hosted_zone_id: str) -> list[ResourceRecordSet]:
+        """The raw full-zone drain — the read plane's Route53 loader."""
         return self._drain_pages(
             lambda token: self.route53.list_resource_record_sets(
                 hosted_zone_id, 300, token
             )
         )
+
+    def _list_record_sets(self, hosted_zone_id: str) -> list[ResourceRecordSet]:
+        """All record sets of a zone.  With the optional RecordSetCache
+        the N-per-zone ensures of one tick window share a single
+        snapshot (the driver's own change batches are folded back in —
+        see ``_change_record_sets``); without it, the legacy per-call
+        drain."""
+        if self._record_cache is None:
+            return self._fetch_record_sets(hosted_zone_id)
+        return self._record_cache.get(
+            hosted_zone_id, lambda: self._fetch_record_sets(hosted_zone_id)
+        )
+
+    def _change_record_sets(self, hosted_zone_id: str, changes: list[Change]) -> None:
+        """The ONE write path to Route53: commits the batch, then folds
+        it into the zone snapshot (write-through).  A rejected batch
+        invalidates the snapshot — InvalidChangeBatch means our view
+        of the zone lied (CREATE of an existing record / DELETE of a
+        missing one), NoSuchHostedZone that the zone itself is gone —
+        so the backoff retry re-reads instead of re-failing for the
+        rest of the TTL."""
+        try:
+            self.route53.change_resource_record_sets(hosted_zone_id, changes)
+        except AWSAPIError as err:
+            if self._record_cache is not None and err.code in (
+                "InvalidChangeBatch", "NoSuchHostedZone"
+            ):
+                self._record_cache.invalidate(hosted_zone_id)
+            raise
+        if self._record_cache is not None:
+            self._record_cache.apply_changes(hosted_zone_id, changes)
 
     @staticmethod
     def _owned_record_names(
@@ -1097,7 +1245,7 @@ class AWSDriver:
         ``a_action`` is UPSERT when a surviving A already aliases this
         accelerator (TXT deleted out-of-band) so the pair repair never
         wedges on CREATE-of-existing."""
-        self.route53.change_resource_record_sets(
+        self._change_record_sets(
             hosted_zone.id,
             [
                 Change(
@@ -1131,7 +1279,7 @@ class AWSDriver:
         accelerator: Accelerator,
         action: str,
     ) -> None:
-        self.route53.change_resource_record_sets(
+        self._change_record_sets(
             hosted_zone.id,
             [
                 Change(
@@ -1175,12 +1323,12 @@ class AWSDriver:
     def _cleanup_owned_records(self, zones, owner_value: str) -> None:
         for zone in zones:
             for record in self.find_owned_a_record_sets(zone, owner_value):
-                self.route53.change_resource_record_sets(
+                self._change_record_sets(
                     zone.id, [Change(CHANGE_ACTION_DELETE, record)]
                 )
                 klog.infof("Record set %s: %s is deleted", record.name, record.type)
             for record in self._find_owned_metadata_record_sets(zone, owner_value):
-                self.route53.change_resource_record_sets(
+                self._change_record_sets(
                     zone.id, [Change(CHANGE_ACTION_DELETE, record)]
                 )
                 klog.infof("Record set %s: %s is deleted", record.name, record.type)

@@ -14,6 +14,15 @@ from the environment so annotated Services find their load balancers:
 - ``AGAC_FAKE_ZONES``: comma-separated hosted-zone names.
 
 The default mode builds the real SigV4 HTTP backend.
+
+Cache wiring: one process-wide instance of each cache, shared by the
+per-reconcile drivers — the discovery and hosted-zone snapshots plus
+the three coalesced-read-plane caches (accelerator topology, per-zone
+record sets, and the per-REGION DescribeLoadBalancers coalescers; a
+batch goes out through one region's endpoint, so coalescers must
+never be shared across regions).  TTLs come from the environment
+(table in docs/operations.md "Runtime knobs"); the ``controller``
+subcommand's ``--read-plane-ttl`` flag feeds ``configure_read_plane``.
 """
 
 from __future__ import annotations
@@ -21,69 +30,80 @@ from __future__ import annotations
 import os
 import threading
 
-from .cache import DiscoveryCache, HostedZoneCache
+from .cache import (
+    AcceleratorTopologyCache,
+    DiscoveryCache,
+    HostedZoneCache,
+    LoadBalancerCoalescer,
+    RecordSetCache,
+)
 from .driver import AWSDriver
 from .fake_backend import FakeAWSBackend
 from .load_balancer import get_lb_name_from_hostname
 
 _fake_backend: FakeAWSBackend | None = None
 _lock = threading.Lock()
-# one process-wide discovery cache shared by the per-reconcile drivers
-# (ttl via AGAC_DISCOVERY_CACHE_TTL; 0 disables)
+# process-wide cache singletons shared by the per-reconcile drivers
 _discovery_cache: DiscoveryCache | None = None
+_zone_cache: HostedZoneCache | None = None
+_topology_cache: AcceleratorTopologyCache | None = None
+_record_cache: RecordSetCache | None = None
+_lb_coalescers: dict[str, LoadBalancerCoalescer] = {}
+
+# memoized TTL values (env parsed once per process; a malformed value
+# must not poison every reconcile — fall back and say so once)
+_ttl_values: dict[str, float] = {}
+# explicit overrides (CLI flags) beat the environment
+_ttl_overrides: dict[str, float] = {}
 
 
-_discovery_ttl: float | None = None
+def _env_float(name: str, default: float) -> float:
+    if name in _ttl_overrides:
+        return _ttl_overrides[name]
+    if name in _ttl_values:
+        return _ttl_values[name]
+    raw = os.environ.get(name, str(default))
+    try:
+        value = float(raw)
+    except ValueError:
+        from ... import klog
+
+        klog.errorf("%s=%r is not a number; using default %gs", name, raw, default)
+        value = default
+    _ttl_values[name] = value
+    return value
+
+
+def configure_read_plane(ttl: float | None) -> None:
+    """Pin the three read-plane TTLs from the CLI (``--read-plane-ttl``):
+    one knob for the verification-read tick scope.  ``None`` keeps the
+    per-cache environment variables / defaults; 0 disables the read
+    plane entirely (reference-parity per-object reads)."""
+    if ttl is None:
+        return
+    for name in (
+        "AGAC_TOPOLOGY_VERIFY_TTL",
+        "AGAC_RECORDSET_CACHE_TTL",
+        "AGAC_LB_CACHE_TTL",
+    ):
+        _ttl_overrides[name] = ttl
 
 
 def _discovery_cache_ttl() -> float:
-    global _discovery_ttl
-    if _discovery_ttl is not None:
-        return _discovery_ttl
     # 30 s default: the write journal (cache.py) makes the TTL a pure
     # cross-process staleness bound — local writes are always visible —
     # so it can match the 30 s informer-resync staleness the reference
     # already tolerates; measured at N=1000 this cuts refresh scans 6x
     # vs the old 5 s with no correctness cost
-    raw = os.environ.get("AGAC_DISCOVERY_CACHE_TTL", "30")
-    try:
-        ttl = float(raw)
-    except ValueError:
-        # a malformed value must not poison every reconcile; fall back
-        # to the default and say so once per process (memoization
-        # below is the dedup)
-        from ... import klog
-
-        klog.errorf(
-            "AGAC_DISCOVERY_CACHE_TTL=%r is not a number; using default 30s", raw
-        )
-        ttl = 30.0
-    _discovery_ttl = ttl
-    return ttl
-
-
-_zone_cache: HostedZoneCache | None = None
-_zone_ttl: float | None = None
+    return _env_float("AGAC_DISCOVERY_CACHE_TTL", 30.0)
 
 
 def _zone_cache_ttl() -> float:
-    global _zone_ttl
-    if _zone_ttl is not None:
-        return _zone_ttl
     # 60 s: hosted zones are created by humans, not this controller —
     # the TTL only bounds how long a zone deleted out-of-band keeps
     # resolving (and the ensure path invalidates explicitly on
     # NoSuchHostedZone anyway); 0 disables
-    raw = os.environ.get("AGAC_ZONE_CACHE_TTL", "60")
-    try:
-        ttl = float(raw)
-    except ValueError:
-        from ... import klog
-
-        klog.errorf("AGAC_ZONE_CACHE_TTL=%r is not a number; using default 60s", raw)
-        ttl = 60.0
-    _zone_ttl = ttl
-    return ttl
+    return _env_float("AGAC_ZONE_CACHE_TTL", 60.0)
 
 
 def _shared_zone_cache() -> HostedZoneCache | None:
@@ -106,6 +126,57 @@ def _shared_discovery_cache() -> DiscoveryCache | None:
         if _discovery_cache is None:
             _discovery_cache = DiscoveryCache(ttl=ttl)
         return _discovery_cache
+
+
+def _shared_topology_cache() -> AcceleratorTopologyCache | None:
+    global _topology_cache
+    # 15 s verify window: the verification dedup scope of one drift
+    # tick (periods are >= 300 s at any fleet size worth ticking, see
+    # docs/operations.md); 0 disables.  The full-relist TTL bounds how
+    # long the write-through listener identity is trusted before ports/
+    # protocol are re-read from AWS — 900 s keeps that within a few
+    # ticks at production periods.
+    verify_ttl = _env_float("AGAC_TOPOLOGY_VERIFY_TTL", 15.0)
+    full_ttl = _env_float("AGAC_TOPOLOGY_FULL_TTL", 900.0)
+    if verify_ttl <= 0:
+        return None
+    with _lock:
+        if _topology_cache is None:
+            _topology_cache = AcceleratorTopologyCache(
+                verify_ttl=verify_ttl, full_ttl=max(full_ttl, verify_ttl)
+            )
+        return _topology_cache
+
+
+def _shared_record_cache() -> RecordSetCache | None:
+    global _record_cache
+    # 15 s: the per-zone snapshot scope of one verification round; the
+    # driver folds its own change batches back in, so the TTL only
+    # bounds detection of OUT-OF-BAND record edits; 0 disables
+    ttl = _env_float("AGAC_RECORDSET_CACHE_TTL", 15.0)
+    if ttl <= 0:
+        return None
+    with _lock:
+        if _record_cache is None:
+            _record_cache = RecordSetCache(ttl=ttl)
+        return _record_cache
+
+
+def _shared_lb_coalescer(region: str) -> LoadBalancerCoalescer | None:
+    # 15 s: LB state/DNS are re-read every verification round; the
+    # 10 ms gather window turns a tick's concurrent single-name
+    # lookups into ~worker-pool-sized wire batches; 0 disables
+    ttl = _env_float("AGAC_LB_CACHE_TTL", 15.0)
+    if ttl <= 0:
+        return None
+    window = _env_float("AGAC_LB_BATCH_WINDOW", 0.01)
+    with _lock:
+        coalescer = _lb_coalescers.get(region)
+        if coalescer is None:
+            coalescer = _lb_coalescers[region] = LoadBalancerCoalescer(
+                ttl=ttl, batch_window=max(window, 0.0)
+            )
+        return coalescer
 
 
 def _seed_from_environment(backend: FakeAWSBackend) -> None:
@@ -136,19 +207,39 @@ def shared_fake_backend() -> FakeAWSBackend:
         return _fake_backend
 
 
+def read_plane_stats() -> dict:
+    """Efficacy counters of every live cache (hits / misses /
+    single-flight waits / batch sizes) — the observability hook the
+    bench exports per phase."""
+    stats = {}
+    with _lock:
+        named = {
+            "discovery": _discovery_cache,
+            "zones": _zone_cache,
+            "topology": _topology_cache,
+            "record_sets": _record_cache,
+        }
+        coalescers = dict(_lb_coalescers)
+    for name, cache in named.items():
+        if cache is not None:
+            stats[name] = cache.stats()
+    for region, coalescer in coalescers.items():
+        stats[f"load_balancers[{region}]"] = coalescer.stats()
+    return stats
+
+
 def real_cloud_factory(region: str) -> AWSDriver:
-    cache = _shared_discovery_cache()
-    zone_cache = _shared_zone_cache()
+    caches = dict(
+        discovery_cache=_shared_discovery_cache(),
+        zone_cache=_shared_zone_cache(),
+        topology_cache=_shared_topology_cache(),
+        record_cache=_shared_record_cache(),
+        lb_coalescer=_shared_lb_coalescer(region),
+    )
     if os.environ.get("AGAC_CLOUD") == "fake":
         backend = shared_fake_backend()
-        return AWSDriver(
-            backend, backend, backend,
-            discovery_cache=cache, zone_cache=zone_cache,
-        )
+        return AWSDriver(backend, backend, backend, **caches)
     from .real_backend import RealAWSClients
 
     clients = RealAWSClients.from_environment(region)
-    return AWSDriver(
-        clients.ga, clients.elbv2, clients.route53,
-        discovery_cache=cache, zone_cache=zone_cache,
-    )
+    return AWSDriver(clients.ga, clients.elbv2, clients.route53, **caches)

@@ -375,6 +375,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
 
     def list_listeners(self, accelerator_arn, max_results, next_token):
         with self._lock:
+            self.calls.append(("ListListeners", accelerator_arn))
             state = self._get_state(accelerator_arn)
             items = [
                 Listener(
@@ -445,6 +446,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
 
     def list_endpoint_groups(self, listener_arn, max_results, next_token):
         with self._lock:
+            self.calls.append(("ListEndpointGroups", listener_arn))
             self._get_listener(listener_arn)  # existence check
             items = [
                 self._copy_eg(eg)
@@ -465,6 +467,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
 
     def describe_endpoint_group(self, arn):
         with self._lock:
+            self.calls.append(("DescribeEndpointGroup", arn))
             eg = self._endpoint_groups.get(arn)
             if eg is None:
                 raise EndpointGroupNotFoundException(arn)
@@ -594,6 +597,9 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
     # ------------------------------------------------------------------
     def describe_load_balancers(self, names):
         with self._lock:
+            # batch size in the log so the read-plane call-budget and
+            # coalescer tests can assert wire-call counts AND widths
+            self.calls.append(("DescribeLoadBalancers", len(names)))
             found = [
                 LoadBalancer(**vars(self._load_balancers[n]))
                 for n in names
@@ -618,6 +624,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
 
     def list_hosted_zones(self, max_items, marker):
         with self._lock:
+            self.calls.append(("ListHostedZones",))
             zones = sorted(self._zones.values(), key=lambda z: z.name)
             return _paginate([HostedZone(**vars(z)) for z in zones], max_items, marker)
 
@@ -626,6 +633,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         if not dns_name.endswith("."):
             dns_name += "."
         with self._lock:
+            self.calls.append(("ListHostedZonesByName", dns_name))
             # Route53 orders by reversed-label DNS name; plain name sort
             # is enough for the "does an exact zone exist" probe the
             # driver performs (reference route53.go:337-357).
@@ -647,6 +655,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
 
     def list_resource_record_sets(self, hosted_zone_id, max_items, start_record_name):
         with self._lock:
+            self.calls.append(("ListResourceRecordSets", hosted_zone_id))
             if hosted_zone_id not in self._zones:
                 raise AWSAPIError(ERR_NO_SUCH_HOSTED_ZONE, hosted_zone_id)
             records = sorted(

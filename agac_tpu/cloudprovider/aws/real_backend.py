@@ -616,7 +616,22 @@ class RealELBv2API(ELBv2API):
             sleep=sleep,
         )
 
+    # DescribeLoadBalancers accepts at most 20 names per request
+    # (ELBv2 API reference); the read plane's coalescer batches up to
+    # exactly this, but a direct caller with a wider list must not get
+    # a ValidationError — chunk and concatenate.
+    MAX_NAMES_PER_CALL = 20
+
     def describe_load_balancers(self, names):
+        if len(names) > self.MAX_NAMES_PER_CALL:
+            found = []
+            for i in range(0, len(names), self.MAX_NAMES_PER_CALL):
+                found.extend(
+                    self.describe_load_balancers(
+                        names[i : i + self.MAX_NAMES_PER_CALL]
+                    )
+                )
+            return found
         params = {"Action": "DescribeLoadBalancers", "Version": ELBV2_API_VERSION}
         for i, name in enumerate(names, start=1):
             params[f"Names.member.{i}"] = name

@@ -78,6 +78,16 @@ def build_parser() -> argparse.ArgumentParser:
         "(client-go's default 1000 is far past useful for external-API "
         "retries; lower it to bound worst-case repair latency).",
     )
+    controller.add_argument(
+        "--read-plane-ttl", type=float, default=None,
+        help="Tick scope (seconds) of the coalesced verification read "
+        "plane: accelerator-topology, record-set and load-balancer "
+        "reads are shared within one window of this length and re-read "
+        "after it. Default 15; 0 disables coalescing (reference-parity "
+        "per-object reads). Fine-grained knobs: AGAC_TOPOLOGY_VERIFY_TTL, "
+        "AGAC_TOPOLOGY_FULL_TTL, AGAC_RECORDSET_CACHE_TTL, "
+        "AGAC_LB_CACHE_TTL, AGAC_LB_BATCH_WINDOW.",
+    )
 
     webhook = sub.add_parser("webhook", help="Start webhook server")
     webhook.add_argument(
@@ -159,7 +169,9 @@ def run_controller(args) -> int:
     )
     stop = setup_signal_handler()
 
-    from ..cloudprovider.aws.factory import real_cloud_factory
+    from ..cloudprovider.aws.factory import configure_read_plane, real_cloud_factory
+
+    configure_read_plane(args.read_plane_ttl)
 
     def run_manager(stop_event):
         Manager().run(

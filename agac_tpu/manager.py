@@ -100,3 +100,20 @@ class Manager:
             for thread in threads:
                 thread.join(timeout=5)
         return threads
+
+    def drift_tick(self) -> int:
+        """Drive ONE drift-resync round explicitly: walk every
+        registered controller's own ``drift_resync_sources()`` — the
+        same lister/predicate/enqueue triples the in-process ticker
+        consumes, so an external tick can never diverge from a real
+        one.  Returns the number of enqueued objects.  Used by the
+        bench's drift-tick phase and the call-budget regression tier
+        to bracket exactly one round."""
+        enqueued = 0
+        for controller in self.controllers.values():
+            for lister, predicate, enqueue in controller.drift_resync_sources():
+                for obj in lister.list():
+                    if predicate(obj):
+                        enqueue(obj)
+                        enqueued += 1
+        return enqueued

@@ -24,7 +24,7 @@ import heapq
 import threading
 import time
 from collections import deque
-from typing import Any, Hashable, Optional
+from typing import Any, Callable, Hashable, Optional
 
 from ..analysis import racecheck
 
@@ -62,18 +62,28 @@ class BucketRateLimiter:
 
     ``when`` reserves a token and returns how long the caller must wait
     for it, like golang.org/x/time/rate's ``Reserve().Delay()``.
+
+    ``clock`` is injectable (default ``time.monotonic``) so limiter and
+    queue tests drive refill with a fake clock instead of sleeping real
+    wall time.
     """
 
-    def __init__(self, qps: float = 10.0, burst: int = 100):
+    def __init__(
+        self,
+        qps: float = 10.0,
+        burst: int = 100,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self._qps = qps
         self._burst = burst
         self._tokens = float(burst)
-        self._last = time.monotonic()
+        self._clock = clock
+        self._last = clock()
         self._lock = threading.Lock()
 
     def when(self, item: Hashable) -> float:
         with self._lock:
-            now = time.monotonic()
+            now = self._clock()
             self._tokens = min(self._burst, self._tokens + (now - self._last) * self._qps)
             self._last = now
             self._tokens -= 1.0
@@ -111,7 +121,10 @@ def default_controller_rate_limiter() -> MaxOfRateLimiter:
 
 
 def controller_rate_limiter(
-    qps: float = 10.0, burst: int = 100, max_backoff: float = 1000.0
+    qps: float = 10.0,
+    burst: int = 100,
+    max_backoff: float = 1000.0,
+    clock: Callable[[], float] = time.monotonic,
 ) -> MaxOfRateLimiter:
     """The client-go default shape (per-item exponential + overall
     bucket) with a tunable bucket — the analog of passing a custom
@@ -121,12 +134,13 @@ def controller_rate_limiter(
     qps <= 0 means "no overall bucket" (per-item backoff only).
     ``max_backoff`` caps the per-item exponential delay (client-go's
     1000 s default is far past useful for external-API retries; many
-    controllers cap at seconds)."""
+    controllers cap at seconds).  ``clock`` is threaded through to the
+    bucket so tests drive refill with a fake clock."""
     if qps <= 0:
         return MaxOfRateLimiter(ItemExponentialFailureRateLimiter(0.005, max_backoff))
     return MaxOfRateLimiter(
         ItemExponentialFailureRateLimiter(0.005, max_backoff),
-        BucketRateLimiter(qps, burst),
+        BucketRateLimiter(qps, burst, clock=clock),
     )
 
 
@@ -141,10 +155,20 @@ class RateLimitingQueue:
     ``get`` wait on ``_ready`` while the single delay-waker thread
     waits on ``_delay``, so a ``notify`` for one never gets consumed
     by the other.
+
+    ``clock`` is injectable for delay tests: with a fake clock, a test
+    advances time and calls ``kick_delays()`` so the waker re-examines
+    the heap instead of the test sleeping real wall seconds.
     """
 
-    def __init__(self, rate_limiter=None, name: str = ""):
+    def __init__(
+        self,
+        rate_limiter=None,
+        name: str = "",
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.name = name
+        self._clock = clock
         self._limiter = rate_limiter or default_controller_rate_limiter()
         # racecheck seam: a plain Lock unless the lock-order watchdog
         # is enabled (tests), in which case acquisition order across
@@ -186,6 +210,9 @@ class RateLimitingQueue:
         expiry returns ``(None, False)`` — callers that poll must
         distinguish it from shutdown.
         """
+        # real wall clock on purpose, independent of the injected
+        # delay clock: get() blocks a live worker thread, and a fake
+        # delay clock must not turn a poll timeout into a hang
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._mutex:
             while not self._queue and not self._shutting_down:
@@ -230,13 +257,20 @@ class RateLimitingQueue:
             if self._shutting_down:
                 return
             self._seq += 1
-            heapq.heappush(self._waiting, (time.monotonic() + delay, self._seq, item))
+            heapq.heappush(self._waiting, (self._clock() + delay, self._seq, item))
+            self._delay.notify()
+
+    def kick_delays(self) -> None:
+        """Wake the delay waker to re-examine the heap now — the seam
+        fake-clock tests use after advancing their clock (a fake clock
+        cannot make ``Condition.wait`` return early)."""
+        with self._mutex:
             self._delay.notify()
 
     def _waiting_loop(self) -> None:
         with self._mutex:
             while not self._shutting_down:
-                now = time.monotonic()
+                now = self._clock()
                 while self._waiting and self._waiting[0][0] <= now:
                     _, _, item = heapq.heappop(self._waiting)
                     self._add_locked(item)

@@ -277,6 +277,81 @@ class TestSuppression:
 
 
 # ---------------------------------------------------------------------------
+# drift-read-outside-read-plane
+# ---------------------------------------------------------------------------
+
+
+class TestDriftReadOutsideReadPlane:
+    DRIVER = "agac_tpu/cloudprovider/aws/driver.py"
+
+    def test_raw_read_in_ensure_path_fires_once(self):
+        v = only(
+            run(
+                """
+                class AWSDriver:
+                    def _ensure_thing(self, arn):
+                        return self.ga.list_listeners(arn, 100, None)
+                """,
+                path=self.DRIVER,
+            ),
+            "drift-read-outside-read-plane",
+        )
+        assert "ga.list_listeners" in v.message and "read plane" in v.message
+
+    def test_raw_describe_on_route53_handle_fires(self):
+        only(
+            run(
+                """
+                class AWSDriver:
+                    def _verify_records(self, zone_id):
+                        return self.route53.list_resource_record_sets(zone_id, 300, None)
+                """,
+                path=self.DRIVER,
+            ),
+            "drift-read-outside-read-plane",
+        )
+
+    def test_sanctioned_loader_is_clean(self):
+        assert (
+            run(
+                """
+                class AWSDriver:
+                    def _fetch_record_sets(self, zone_id):
+                        return self.route53.list_resource_record_sets(zone_id, 300, None)
+
+                    def _describe_load_balancers(self, names):
+                        return self.elbv2.describe_load_balancers(names)
+                """,
+                path=self.DRIVER,
+            )
+            == []
+        )
+
+    def test_mutates_are_not_reads(self):
+        assert (
+            run(
+                """
+                class AWSDriver:
+                    def _repair(self, arn):
+                        self.ga.update_accelerator(arn, enabled=True)
+                """,
+                path=self.DRIVER,
+            )
+            == []
+        )
+
+    def test_rule_is_scoped_to_the_driver_module(self):
+        # backends and tests list raw ops by design
+        assert (
+            run(
+                "def probe(ga):\n    return ga.list_listeners('arn', 100, None)\n",
+                path="tests/test_something.py",
+            )
+            == []
+        )
+
+
+# ---------------------------------------------------------------------------
 # the repo itself + CI wiring
 # ---------------------------------------------------------------------------
 
@@ -289,6 +364,7 @@ def test_rule_registry_ships_the_documented_rules():
         "blocking-reconcile",
         "reconcile-returns-result",
         "unguarded-optional-import",
+        "drift-read-outside-read-plane",
     }
 
 

@@ -1,0 +1,228 @@
+"""Drift-tick call-budget regression tier (ISSUE 2): a scaled-down
+converged fleet, one explicitly driven ticker round through
+``Manager.drift_tick()`` (the same source wiring the in-process ticker
+and the bench use), and a hard ceiling on the AWS calls that round may
+cost with the coalesced read plane on.
+
+The ceiling is the contract the read plane exists to keep: one GA read
+per accelerator (the chain-tail verify), one ListResourceRecordSets
+per hosted zone, batched DescribeLoadBalancers, one
+DescribeEndpointGroup per binding — and ZERO mutates on a converged
+fleet.  A stray per-item read sneaking back into a verify path fails
+this tier long before it shows up as a 4x quota bill in the full
+bench (where the same regression is only visible as a trajectory
+change in BENCH_r*.json)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from agac_tpu import apis
+from agac_tpu.apis.endpointgroupbinding.v1alpha1 import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.cache import (
+    AcceleratorTopologyCache,
+    DiscoveryCache,
+    HostedZoneCache,
+    LoadBalancerCoalescer,
+    RecordSetCache,
+)
+from agac_tpu.controllers import (
+    EndpointGroupBindingConfig,
+    GlobalAcceleratorConfig,
+    Route53Config,
+)
+from agac_tpu.cluster import FakeCluster, ObjectMeta
+from agac_tpu.manager import ControllerConfig, Manager
+
+from .fixtures import NLB_REGION, make_lb_service
+
+N_SERVICES = 6
+N_ZONES = 2
+# tick scope of the verification caches: long enough that one tick's
+# reads coalesce, short enough to be expired by the measured round
+# after the quiescence wait below
+TICK_TTL = 0.3
+QUIET_NEED = 0.5
+
+READ_OPS = (
+    "ListAccelerators", "ListTagsForResource", "ListListeners",
+    "ListEndpointGroups", "DescribeAccelerator", "DescribeEndpointGroup",
+    "DescribeLoadBalancers", "ListHostedZones", "ListHostedZonesByName",
+    "ListResourceRecordSets",
+)
+MUTATE_OPS = (
+    "CreateAccelerator", "UpdateAccelerator", "DeleteAccelerator",
+    "CreateListener", "UpdateListener", "DeleteListener",
+    "CreateEndpointGroup", "UpdateEndpointGroup", "DeleteEndpointGroup",
+    "AddEndpoints", "RemoveEndpoints", "TagResource",
+    "ChangeResourceRecordSets",
+)
+
+# The budget, itemized (see module docstring).  LB describes are
+# batched but batch sizes depend on worker interleaving, so the
+# ceiling admits the degenerate all-singles case:
+#   6 ListEndpointGroups (chain verify, one per accelerator)
+# + 2 ListResourceRecordSets (one per zone)
+# + 7 DescribeLoadBalancers wire calls max (6 services + 1 binding ref)
+# + 1 DescribeEndpointGroup (binding verify)
+# + 4 slack (an unlucky discovery/zone refresh landing mid-tick)
+TICK_CALL_CEILING = 20
+
+
+def hostname_of(i: int) -> str:
+    return f"svc{i}.z{i % N_ZONES}.budget.example.com"
+
+
+def lb_hostname(i: int) -> str:
+    return f"lb{i}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+
+
+def wait_until(probe, timeout=30.0, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if probe():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {message}")
+
+
+def wait_quiescent(aws, timeout=30.0):
+    """Block until no AWS call lands for QUIET_NEED seconds (also lets
+    the tick-scoped TTLs expire, so the measured round re-reads)."""
+    deadline = time.monotonic() + timeout
+    last = len(aws.calls)
+    quiet_since = time.monotonic()
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        cur = len(aws.calls)
+        if cur != last:
+            last, quiet_since = cur, time.monotonic()
+        elif time.monotonic() - quiet_since >= QUIET_NEED:
+            return
+    pytest.fail("fleet never went AWS-quiescent")
+
+
+def test_converged_tick_stays_within_call_budget():
+    aws = FakeAWSBackend(quota_accelerators=N_SERVICES + 5)
+    cluster = FakeCluster()
+    zones = [aws.add_hosted_zone(f"z{k}.budget.example.com") for k in range(N_ZONES)]
+    for i in range(N_SERVICES):
+        aws.add_load_balancer(f"lb{i}", NLB_REGION, lb_hostname(i))
+
+    # one binding bound into an out-of-band endpoint group (the same
+    # fixture shape the bench and EGB drift tests use)
+    seed_driver = AWSDriver(aws, aws, aws)
+    seed_svc = make_lb_service(name="seed", hostname=lb_hostname(0))
+    arn, _, _ = seed_driver.ensure_global_accelerator_for_service(
+        seed_svc, seed_svc.status.load_balancer.ingress[0],
+        "external", "lb0", NLB_REGION,
+    )
+    seed_eg = seed_driver.get_endpoint_group(
+        seed_driver.get_listener(arn).listener_arn
+    )
+
+    for i in range(N_SERVICES):
+        svc = make_lb_service(name=f"svc{i}", hostname=lb_hostname(i))
+        svc.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = hostname_of(i)
+        # the fixture names its LB after the service; point it at ours
+        cluster.create("Service", svc)
+    cluster.create(
+        "EndpointGroupBinding",
+        EndpointGroupBinding(
+            metadata=ObjectMeta(name="binding", namespace="default"),
+            spec=EndpointGroupBindingSpec(
+                endpoint_group_arn=seed_eg.endpoint_group_arn,
+                weight=100,
+                service_ref=ServiceReference(name="svc0"),
+            ),
+        ),
+    )
+
+    # shared read plane, exactly as the factory wires it (discovery /
+    # zone snapshots sized to stay warm across the measured tick)
+    discovery = DiscoveryCache(ttl=300.0)
+    zone_cache = HostedZoneCache(ttl=300.0)
+    topology = AcceleratorTopologyCache(verify_ttl=TICK_TTL, full_ttl=300.0)
+    records = RecordSetCache(ttl=TICK_TTL)
+    lbs = LoadBalancerCoalescer(ttl=TICK_TTL, batch_window=0.02)
+
+    stop = threading.Event()
+    dormant = 10_000.0  # > 0 arms the EGB converged-path verify; never fires
+    config = ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(
+            workers=2, queue_qps=1000.0, queue_burst=1000,
+            drift_resync_period=dormant,
+        ),
+        route53=Route53Config(
+            workers=2, queue_qps=1000.0, queue_burst=1000,
+            drift_resync_period=dormant,
+        ),
+        endpoint_group_binding=EndpointGroupBindingConfig(
+            workers=1, queue_qps=1000.0, queue_burst=1000,
+            drift_resync_period=dormant,
+        ),
+    )
+    manager = Manager(resync_period=dormant)
+    manager.run(
+        cluster, config, stop,
+        cloud_factory=lambda region: AWSDriver(
+            aws, aws, aws,
+            accelerator_missing_retry=0.1,
+            discovery_cache=discovery,
+            zone_cache=zone_cache,
+            topology_cache=topology,
+            record_cache=records,
+            lb_coalescer=lbs,
+        ),
+        block=False,
+    )
+    try:
+        def converged():
+            if len(aws.all_accelerator_arns()) < 1 + N_SERVICES:
+                return False
+            records_up = sum(len(aws.records_in_zone(z.id)) for z in zones)
+            if records_up < 2 * N_SERVICES:
+                return False
+            binding = cluster.get("EndpointGroupBinding", "default", "binding")
+            return len(binding.status.endpoint_ids) == 1
+
+        wait_until(converged, message="fleet convergence")
+        wait_quiescent(aws)
+
+        before = len(aws.calls)
+        enqueued = manager.drift_tick()
+        assert enqueued >= 2 * N_SERVICES + 1  # GA + Route53 sources + EGB
+        wait_quiescent(aws)
+        tick_calls = aws.calls[before:]
+    finally:
+        stop.set()
+
+    by_op: dict[str, int] = {}
+    for call in tick_calls:
+        by_op[call[0]] = by_op.get(call[0], 0) + 1
+
+    mutates = {op: n for op, n in by_op.items() if op in MUTATE_OPS}
+    assert not mutates, f"converged tick mutated AWS: {mutates}"
+    total = sum(n for op, n in by_op.items() if op in READ_OPS)
+    assert total <= TICK_CALL_CEILING, (
+        f"drift tick cost {total} AWS calls (ceiling {TICK_CALL_CEILING}): {by_op}"
+    )
+    # and the tick genuinely VERIFIED, not just skipped reads: every
+    # accelerator chain tail re-read, every zone re-listed, the
+    # binding's endpoint group re-described
+    assert by_op.get("ListEndpointGroups", 0) >= N_SERVICES, by_op
+    assert by_op.get("ListResourceRecordSets", 0) >= N_ZONES, by_op
+    assert by_op.get("DescribeEndpointGroup", 0) >= 1, by_op
+    # LB verification still covered every distinct LB on the wire
+    # (the binding's ref shares svc0's lb0 entry within the tick —
+    # that cross-controller hit is the coalescing working)
+    lb_lookups = sum(size for op, size in aws.calls[before:] if op == "DescribeLoadBalancers")
+    assert lb_lookups >= N_SERVICES, "tick skipped LB verification"

@@ -570,6 +570,145 @@ class TestEndpointGroupBindingDrift:
             stop.set()
 
 
+class TestCoalescedTickFreshness:
+    """ISSUE 2 freshness contract: with the FULL coalesced read plane
+    wired in (topology + record-set + LB caches shared across every
+    per-reconcile driver, tick-scoped TTLs), drift ticks still detect
+    and repair out-of-band deletion/mutation of a listener, a record
+    set, and an LB endpoint — coalescing reads within a round must
+    never mean trusting stale state across rounds."""
+
+    # TTLs well under the drift period: each tick re-reads AWS
+    CACHE_TTL = 0.05
+
+    def run_coalesced_manager(self, aws):
+        from agac_tpu.cloudprovider.aws.cache import (
+            AcceleratorTopologyCache,
+            DiscoveryCache,
+            LoadBalancerCoalescer,
+            RecordSetCache,
+        )
+
+        cluster = FakeCluster()
+        stop = threading.Event()
+        discovery = DiscoveryCache(ttl=self.CACHE_TTL)
+        topology = AcceleratorTopologyCache(
+            verify_ttl=self.CACHE_TTL, full_ttl=60.0
+        )
+        records = RecordSetCache(ttl=self.CACHE_TTL)
+        lbs = LoadBalancerCoalescer(ttl=self.CACHE_TTL, batch_window=0.0)
+        config = ControllerConfig(
+            global_accelerator=GlobalAcceleratorConfig(
+                workers=2, drift_resync_period=DRIFT_PERIOD
+            ),
+            route53=Route53Config(workers=1, drift_resync_period=DRIFT_PERIOD),
+            endpoint_group_binding=EndpointGroupBindingConfig(
+                workers=1, drift_resync_period=DRIFT_PERIOD
+            ),
+        )
+        manager = Manager(resync_period=300)
+        manager.run(
+            cluster, config, stop,
+            cloud_factory=lambda region: AWSDriver(
+                aws, aws, aws,
+                discovery_cache=discovery,
+                topology_cache=topology,
+                record_cache=records,
+                lb_coalescer=lbs,
+            ),
+            block=False,
+        )
+        return cluster, stop
+
+    def test_tampering_repaired_through_the_coalesced_plane(self, aws):
+        from agac_tpu.cloudprovider.aws.types import AliasTarget, Change, ResourceRecordSet
+
+        zone = next(iter(aws._zones.values()))
+        cluster, stop = self.run_coalesced_manager(aws)
+        try:
+            svc = make_lb_service()
+            svc.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = (
+                "www.example.com"
+            )
+            cluster.create("Service", svc)
+            wait_until(lambda: aws.all_accelerator_arns(), message="create")
+            arn = aws.all_accelerator_arns()[0]
+            wait_until(
+                lambda: len(aws.records_in_zone(zone.id)) >= 2, message="TXT+A"
+            )
+
+            def listener_arns():
+                with aws._lock:
+                    return [
+                        l_arn for l_arn, parent in aws._listener_parent.items()
+                        if parent == arn
+                    ]
+
+            def group_arns():
+                with aws._lock:
+                    state = aws._accelerators.get(arn)
+                    if state is None:
+                        return []
+                    return [
+                        eg_arn for eg_arn, parent in aws._eg_parent.items()
+                        if parent in state.listeners
+                    ]
+
+            # --- LB endpoint deleted out-of-band ---------------------
+            eg_arn = group_arns()[0]
+            aws.remove_endpoints(
+                eg_arn,
+                [
+                    d.endpoint_id
+                    for d in aws.describe_endpoint_group(eg_arn).endpoint_descriptions
+                ],
+            )
+            wait_until(
+                lambda: aws.describe_endpoint_group(
+                    group_arns()[0]
+                ).endpoint_descriptions,
+                message="coalesced tick to re-add the LB endpoint",
+            )
+
+            # --- listener deleted out-of-band ------------------------
+            victim = listener_arns()[0]
+            for eg in group_arns():
+                aws.delete_endpoint_group(eg)
+            aws.delete_listener(victim)
+            wait_until(
+                lambda: listener_arns() and group_arns(),
+                message="coalesced tick to recreate the listener chain",
+            )
+
+            # --- A record repointed out-of-band ----------------------
+            aws.change_resource_record_sets(
+                zone.id,
+                [
+                    Change(
+                        "UPSERT",
+                        ResourceRecordSet(
+                            name="www.example.com",
+                            type="A",
+                            alias_target=AliasTarget(
+                                dns_name="evil.example.net.",
+                                hosted_zone_id="Z2BJ6XQ5FK7U4H",
+                            ),
+                        ),
+                    )
+                ],
+            )
+
+            def a_repaired():
+                for record in aws.records_in_zone(zone.id):
+                    if record.type == "A" and record.name == "www.example.com.":
+                        return "awsglobalaccelerator" in record.alias_target.dns_name
+                return False
+
+            wait_until(a_repaired, message="coalesced tick to repair the A alias")
+        finally:
+            stop.set()
+
+
 class TestTickDegradationUnderReadExhaustion:
     """VERDICT r4 #3: a drift tick over a large fleet is a read burst
     against the ga_read quota.  When the quota is exhausted — workers

@@ -1,0 +1,643 @@
+"""Coalesced-read-plane tests (ISSUE 2): single-flight, journal-fold
+and invalidation semantics for each of the three new caches —
+mirroring the DiscoveryCache tier — plus driver integration proving a
+converged verify costs one GA read per accelerator, one record list
+per zone per window, and batched DescribeLoadBalancers, WITHOUT losing
+tamper detection (the freshness contract the caches exist to honor).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.cache import (
+    AcceleratorTopologyCache,
+    LoadBalancerCoalescer,
+    RecordSetCache,
+)
+from agac_tpu.cloudprovider.aws.errors import (
+    AWSAPIError,
+    ListenerNotFoundException,
+)
+from agac_tpu.cloudprovider.aws.types import (
+    AliasTarget,
+    Change,
+    EndpointGroup,
+    Listener,
+    LoadBalancer,
+    PortRange,
+    ResourceRecord,
+    ResourceRecordSet,
+)
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+
+
+def listener(arn="arn:l1"):
+    return Listener(listener_arn=arn, port_ranges=[PortRange(80, 80)])
+
+
+def endpoint_group(arn="arn:eg1"):
+    return EndpointGroup(endpoint_group_arn=arn, endpoint_group_region="us-west-2")
+
+
+# ---------------------------------------------------------------------------
+# AcceleratorTopologyCache
+# ---------------------------------------------------------------------------
+
+
+class TestTopologyCache:
+    def test_full_load_then_verified_window_hit(self):
+        now = [0.0]
+        cache = AcceleratorTopologyCache(
+            verify_ttl=5.0, full_ttl=100.0, clock=lambda: now[0]
+        )
+        full_loads, verifies = [], []
+
+        def full(arn):
+            full_loads.append(arn)
+            return listener(), endpoint_group()
+
+        def verify(lst):
+            verifies.append(lst.listener_arn)
+            return endpoint_group()
+
+        chain1 = cache.chain("acc", full, verify)
+        chain2 = cache.chain("acc", full, verify)
+        assert chain1 == chain2
+        assert full_loads == ["acc"] and verifies == []
+        assert cache.stats()["hits"] == 1 and cache.stats()["misses"] == 1
+
+    def test_verify_after_window_costs_one_read(self):
+        now = [0.0]
+        cache = AcceleratorTopologyCache(
+            verify_ttl=5.0, full_ttl=100.0, clock=lambda: now[0]
+        )
+        full_loads, verifies = [], []
+        full = lambda arn: (full_loads.append(arn), (listener(), endpoint_group()))[1]
+        verify = lambda lst: (verifies.append(1), endpoint_group("arn:eg2"))[1]
+        cache.chain("acc", full, verify)
+        now[0] = 6.0  # verified window expired, full trust not
+        _, eg = cache.chain("acc", full, verify)
+        assert full_loads == ["acc"] and verifies == [1]
+        assert eg.endpoint_group_arn == "arn:eg2"  # verify refreshed the eg
+
+    def test_full_relist_after_full_ttl(self):
+        now = [0.0]
+        cache = AcceleratorTopologyCache(
+            verify_ttl=5.0, full_ttl=50.0, clock=lambda: now[0]
+        )
+        full_loads = []
+        full = lambda arn: (full_loads.append(arn), (listener(), endpoint_group()))[1]
+        verify = lambda lst: endpoint_group()
+        cache.chain("acc", full, verify)
+        now[0] = 60.0  # past full trust: listener identity re-read
+        cache.chain("acc", full, verify)
+        assert full_loads == ["acc", "acc"]
+
+    def test_write_seed_is_not_verified(self):
+        """A write-through seed reflects our own writes; verification
+        means an AWS read — the next chain() must hit the wire."""
+        cache = AcceleratorTopologyCache(verify_ttl=100.0, full_ttl=100.0)
+        cache.upsert_listener("acc", listener())
+        cache.upsert_endpoint_group("acc", endpoint_group())
+        verifies = []
+        verify = lambda lst: (verifies.append(1), endpoint_group())[1]
+        cache.chain("acc", pytest.fail, verify)  # full load must not happen
+        assert verifies == [1]
+        assert cache.stats()["verifies"] == 1
+
+    def test_verify_not_found_falls_back_to_full_load(self):
+        cache = AcceleratorTopologyCache(verify_ttl=100.0, full_ttl=100.0)
+        cache.upsert_listener("acc", listener("arn:stale"))
+        fresh = listener("arn:fresh")
+
+        def verify(lst):
+            raise ListenerNotFoundException(lst.listener_arn)
+
+        chain = cache.chain("acc", lambda arn: (fresh, endpoint_group()), verify)
+        assert chain[0].listener_arn == "arn:fresh"
+
+    def test_single_flight_and_journal_fold(self):
+        cache = AcceleratorTopologyCache(verify_ttl=100.0, full_ttl=100.0)
+        in_load = threading.Event()
+        release = threading.Event()
+        loads = []
+
+        def slow_full(arn):
+            loads.append(arn)
+            in_load.set()
+            release.wait(5)
+            return listener("arn:loaded"), None
+
+        results = []
+        leader = threading.Thread(
+            target=lambda: results.append(cache.chain("acc", slow_full, None))
+        )
+        leader.start()
+        assert in_load.wait(5)
+        # a concurrent mutate chain replaces the listener mid-load: the
+        # journal must fold it into the stored chain
+        cache.upsert_listener("acc", listener("arn:written"))
+        follower = threading.Thread(
+            target=lambda: results.append(cache.chain("acc", slow_full, None))
+        )
+        follower.start()
+        release.set()
+        leader.join(5)
+        follower.join(5)
+        assert loads == ["acc"], "second chain() must wait, not re-load"
+        assert cache.stats()["waits"] == 1
+        stored = cache.chain("acc", pytest.fail, pytest.fail)  # verified hit
+        assert stored[0].listener_arn == "arn:written"
+
+    def test_invalidate_during_load_poisons_store(self):
+        cache = AcceleratorTopologyCache(verify_ttl=100.0, full_ttl=100.0)
+        in_load = threading.Event()
+        release = threading.Event()
+
+        def slow_full(arn):
+            in_load.set()
+            release.wait(5)
+            return listener(), endpoint_group()
+
+        results = []
+        t = threading.Thread(
+            target=lambda: results.append(cache.chain("acc", slow_full, None))
+        )
+        t.start()
+        assert in_load.wait(5)
+        cache.invalidate("acc")
+        release.set()
+        t.join(5)
+        assert results  # the loader still got its result back
+        # ...but nothing was stored: next read loads again
+        loads = []
+        cache.chain("acc", lambda arn: (loads.append(1), (listener(), None))[1], None)
+        assert loads == [1]
+
+    def test_eg_mutation_by_arn_expires_the_right_chain(self):
+        now = [0.0]
+        cache = AcceleratorTopologyCache(
+            verify_ttl=100.0, full_ttl=100.0, clock=lambda: now[0]
+        )
+        cache.chain("a1", lambda arn: (listener("l1"), endpoint_group("eg1")), None)
+        cache.chain("a2", lambda arn: (listener("l2"), endpoint_group("eg2")), None)
+        cache.invalidate_endpoint_group("eg2")
+        verifies = []
+        cache.chain("a1", pytest.fail, pytest.fail)  # still verified
+        cache.chain(
+            "a2", pytest.fail, lambda lst: (verifies.append(1), endpoint_group("eg2"))[1]
+        )
+        assert verifies == [1]
+
+    def test_load_failure_wakes_waiters_and_clears_flight(self):
+        cache = AcceleratorTopologyCache(verify_ttl=100.0, full_ttl=100.0)
+
+        def boom(arn):
+            raise AWSAPIError("Throttling", "rate exceeded")
+
+        with pytest.raises(AWSAPIError):
+            cache.chain("acc", boom, None)
+        # the flight is cleared: a retry leads a fresh load
+        chain = cache.chain("acc", lambda arn: (listener(), None), None)
+        assert chain[0].listener_arn == "arn:l1"
+
+
+# ---------------------------------------------------------------------------
+# RecordSetCache
+# ---------------------------------------------------------------------------
+
+
+def a_record(name, target="acc.awsglobalaccelerator.com."):
+    return ResourceRecordSet(
+        name=name,
+        type="A",
+        alias_target=AliasTarget(dns_name=target, hosted_zone_id="Z2BJ6XQ5FK7U4H"),
+    )
+
+
+def txt_record(name, value='"heritage=x"'):
+    return ResourceRecordSet(
+        name=name, type="TXT", ttl=300, resource_records=[ResourceRecord(value)]
+    )
+
+
+class TestRecordSetCache:
+    def test_ttl_and_per_zone_isolation(self):
+        now = [0.0]
+        cache = RecordSetCache(ttl=5.0, clock=lambda: now[0])
+        loads = []
+        cache.get("z1", lambda: (loads.append("z1"), [a_record("a.example.com.")])[1])
+        cache.get("z1", lambda: (loads.append("z1"), [])[1])
+        cache.get("z2", lambda: (loads.append("z2"), [])[1])
+        assert loads == ["z1", "z2"]
+        now[0] = 6.0
+        cache.get("z1", lambda: (loads.append("z1"), [])[1])
+        assert loads == ["z1", "z2", "z1"]
+
+    def test_apply_changes_write_through_with_wire_normalization(self):
+        cache = RecordSetCache(ttl=100.0)
+        cache.get("z1", lambda: [])
+        # driver-submitted shapes: bare name, un-dotted alias target,
+        # a wildcard — the snapshot must store what the API would echo
+        cache.apply_changes(
+            "z1",
+            [
+                Change("CREATE", txt_record("*.app.example.com")),
+                Change(
+                    "CREATE",
+                    a_record("app.example.com", target="ga.amazonaws.com"),
+                ),
+            ],
+        )
+        snapshot = cache.get("z1", pytest.fail)
+        by_key = {(r.name, r.type): r for r in snapshot}
+        assert ("\\052.app.example.com.", "TXT") in by_key
+        assert by_key[("app.example.com.", "A")].alias_target.dns_name == (
+            "ga.amazonaws.com."
+        )
+        cache.apply_changes(
+            "z1", [Change("DELETE", txt_record("*.app.example.com"))]
+        )
+        assert [(r.name, r.type) for r in cache.get("z1", pytest.fail)] == [
+            ("app.example.com.", "A")
+        ]
+
+    def test_changes_during_load_fold_into_snapshot(self):
+        cache = RecordSetCache(ttl=100.0)
+        in_load = threading.Event()
+        release = threading.Event()
+
+        def slow_loader():
+            in_load.set()
+            release.wait(5)
+            return [a_record("old.example.com.")]
+
+        results = []
+        t = threading.Thread(target=lambda: results.append(cache.get("z1", slow_loader)))
+        t.start()
+        assert in_load.wait(5)
+        cache.apply_changes("z1", [Change("CREATE", txt_record("new.example.com"))])
+        release.set()
+        t.join(5)
+        names = {(r.name, r.type) for r in cache.get("z1", pytest.fail)}
+        assert names == {("old.example.com.", "A"), ("new.example.com.", "TXT")}
+
+    def test_single_flight_per_zone(self):
+        cache = RecordSetCache(ttl=100.0)
+        in_load = threading.Event()
+        release = threading.Event()
+        loads = []
+
+        def slow_loader():
+            loads.append(1)
+            in_load.set()
+            release.wait(5)
+            return []
+
+        threads = [
+            threading.Thread(target=lambda: cache.get("z1", slow_loader))
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        assert in_load.wait(5)
+        release.set()
+        for t in threads:
+            t.join(5)
+        assert loads == [1]
+        assert cache.stats()["waits"] == 2
+
+    def test_invalidate_during_load_poisons_store(self):
+        cache = RecordSetCache(ttl=100.0)
+        in_load = threading.Event()
+        release = threading.Event()
+
+        def slow_loader():
+            in_load.set()
+            release.wait(5)
+            return []
+
+        t = threading.Thread(target=lambda: cache.get("z1", slow_loader))
+        t.start()
+        assert in_load.wait(5)
+        cache.invalidate("z1")
+        release.set()
+        t.join(5)
+        loads = []
+        cache.get("z1", lambda: (loads.append(1), [])[1])
+        assert loads == [1]
+
+
+# ---------------------------------------------------------------------------
+# LoadBalancerCoalescer
+# ---------------------------------------------------------------------------
+
+
+def lb(name):
+    return LoadBalancer(load_balancer_name=name, load_balancer_arn=f"arn:{name}")
+
+
+class TestLoadBalancerCoalescer:
+    def test_concurrent_lookups_share_one_wire_call(self):
+        coalescer = LoadBalancerCoalescer(ttl=100.0, batch_window=0.05)
+        fetches = []
+        fetch_lock = threading.Lock()
+
+        def fetch(names):
+            with fetch_lock:
+                fetches.append(sorted(names))
+            return [lb(n) for n in names]
+
+        results = {}
+
+        def lookup(name):
+            results[name] = coalescer.get(name, fetch)
+
+        threads = [
+            threading.Thread(target=lookup, args=(f"lb{i}",)) for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert fetches == [[f"lb{i}" for i in range(5)]], fetches
+        assert all(results[f"lb{i}"].load_balancer_name == f"lb{i}" for i in range(5))
+        sizes = coalescer.stats()["batch_sizes"]
+        assert sizes == {5: 1}
+
+    def test_ttl_hit_and_expiry(self):
+        now = [0.0]
+        coalescer = LoadBalancerCoalescer(
+            ttl=5.0, batch_window=0.0, clock=lambda: now[0]
+        )
+        fetches = []
+        fetch = lambda names: (fetches.append(list(names)), [lb(n) for n in names])[1]
+        coalescer.get("a", fetch)
+        coalescer.get("a", fetch)
+        assert len(fetches) == 1 and coalescer.stats()["hits"] == 1
+        now[0] = 6.0
+        coalescer.get("a", fetch)
+        assert len(fetches) == 2
+
+    def test_absent_name_returns_none_and_is_not_cached(self):
+        coalescer = LoadBalancerCoalescer(ttl=100.0, batch_window=0.0)
+        fetches = []
+        fetch = lambda names: (fetches.append(list(names)), [])[1]
+        assert coalescer.get("ghost", fetch) is None
+        assert coalescer.get("ghost", fetch) is None
+        assert len(fetches) == 2, "negative results must not be cached"
+
+    def test_batch_not_found_degrades_to_single_fetches(self):
+        """Real ELBv2 fails a whole multi-name call when ANY name is
+        unknown; one deleted LB must not poison the other lookups."""
+        coalescer = LoadBalancerCoalescer(ttl=100.0, batch_window=0.05)
+        calls = []
+        call_lock = threading.Lock()
+
+        def fetch(names):
+            with call_lock:
+                calls.append(sorted(names))
+            if len(names) > 1:
+                raise AWSAPIError("LoadBalancerNotFound", f"{names} not all found")
+            if names == ["ghost"]:
+                raise AWSAPIError("LoadBalancerNotFound", "ghost not found")
+            return [lb(n) for n in names]
+
+        results = {}
+        errors = {}
+
+        def lookup(name):
+            try:
+                results[name] = coalescer.get(name, fetch)
+            except AWSAPIError as err:
+                errors[name] = err
+
+        threads = [
+            threading.Thread(target=lookup, args=(n,)) for n in ("good", "ghost")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert results["good"].load_balancer_name == "good"
+        assert "ghost" in errors
+        assert ["ghost", "good"] in calls  # the failed batch
+        assert ["good"] in calls and ["ghost"] in calls  # the splits
+
+    def test_other_errors_propagate_to_all_waiters(self):
+        coalescer = LoadBalancerCoalescer(ttl=100.0, batch_window=0.05)
+
+        def fetch(names):
+            raise AWSAPIError("Throttling", "rate exceeded")
+
+        errors = []
+
+        def lookup(name):
+            try:
+                coalescer.get(name, fetch)
+            except AWSAPIError as err:
+                errors.append(err.code)
+
+        threads = [threading.Thread(target=lookup, args=(n,)) for n in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert errors == ["Throttling", "Throttling"]
+
+    def test_batches_cap_at_wire_limit(self):
+        coalescer = LoadBalancerCoalescer(ttl=100.0, batch_window=0.1)
+        seen = []
+        seen_lock = threading.Lock()
+
+        def fetch(names):
+            with seen_lock:
+                seen.append(len(names))
+            return [lb(n) for n in names]
+
+        threads = [
+            threading.Thread(
+                target=lambda i=i: coalescer.get(f"lb{i:02d}", fetch)
+            )
+            for i in range(25)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert sum(seen) == 25
+        assert max(seen) <= LoadBalancerCoalescer.MAX_BATCH
+
+
+# ---------------------------------------------------------------------------
+# driver integration: the coalesced converged verify
+# ---------------------------------------------------------------------------
+
+
+def count_ops(backend, *ops):
+    return sum(1 for c in backend.calls if c[0] in ops)
+
+
+class TestDriverReadPlane:
+    def make_driver(self, backend, **caches):
+        return AWSDriver(
+            backend, backend, backend,
+            poll_interval=0.001, poll_timeout=1.0, **caches,
+        )
+
+    def converge(self, driver, svc):
+        return driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "default", NLB_NAME, NLB_REGION
+        )
+
+    def test_converged_verify_is_one_ga_read(self):
+        backend = FakeAWSBackend()
+        backend.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        now = [0.0]
+        topology = AcceleratorTopologyCache(
+            verify_ttl=5.0, full_ttl=1000.0, clock=lambda: now[0]
+        )
+        driver = self.make_driver(backend, topology_cache=topology)
+        svc = make_lb_service()
+        self.converge(driver, svc)  # create chain (write-through seeds)
+        before_ll = count_ops(backend, "ListListeners")
+        before_eg = count_ops(backend, "ListEndpointGroups")
+        now[0] = 6.0  # new tick window
+        self.converge(driver, svc)  # converged verify
+        assert count_ops(backend, "ListListeners") == before_ll, (
+            "verify must not re-list listeners inside the full-trust window"
+        )
+        assert count_ops(backend, "ListEndpointGroups") == before_eg + 1
+
+    def test_verify_detects_endpoint_removed_out_of_band(self):
+        backend = FakeAWSBackend()
+        backend.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        now = [0.0]
+        topology = AcceleratorTopologyCache(
+            verify_ttl=5.0, full_ttl=1000.0, clock=lambda: now[0]
+        )
+        driver = self.make_driver(backend, topology_cache=topology)
+        svc = make_lb_service()
+        arn, _, _ = self.converge(driver, svc)
+        eg = driver.get_endpoint_group(driver.get_listener(arn).listener_arn)
+        backend.remove_endpoints(
+            eg.endpoint_group_arn,
+            [d.endpoint_id for d in eg.endpoint_descriptions],
+        )
+        now[0] = 6.0  # next tick: the cheap verify must SEE the removal
+        self.converge(driver, svc)
+        repaired = backend.describe_endpoint_group(eg.endpoint_group_arn)
+        assert repaired.endpoint_descriptions, "tamper not repaired through the cache"
+
+    def test_verify_detects_listener_deleted_out_of_band(self):
+        backend = FakeAWSBackend()
+        backend.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        now = [0.0]
+        topology = AcceleratorTopologyCache(
+            verify_ttl=5.0, full_ttl=1000.0, clock=lambda: now[0]
+        )
+        driver = self.make_driver(backend, topology_cache=topology)
+        svc = make_lb_service()
+        arn, _, _ = self.converge(driver, svc)
+        listener_obj = driver.get_listener(arn)
+        eg = driver.get_endpoint_group(listener_obj.listener_arn)
+        backend.delete_endpoint_group(eg.endpoint_group_arn)
+        backend.delete_listener(listener_obj.listener_arn)
+        now[0] = 6.0
+        self.converge(driver, svc)  # verify -> ListenerNotFound -> recreate
+        recreated = driver.get_listener(arn)
+        assert recreated.listener_arn != listener_obj.listener_arn
+        assert driver.get_endpoint_group(recreated.listener_arn)
+
+    def test_record_plane_shares_one_zone_list_and_detects_tamper(self):
+        backend = FakeAWSBackend()
+        backend.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        zone = backend.add_hosted_zone("example.com")
+        now = [0.0]
+        records = RecordSetCache(ttl=5.0, clock=lambda: now[0])
+        driver = self.make_driver(backend, record_cache=records)
+        svc = make_lb_service()
+        arn, _, _ = self.converge(driver, svc)
+        before = count_ops(backend, "ListResourceRecordSets")
+        created, _ = driver.ensure_route53_for_service(
+            svc, svc.status.load_balancer.ingress[0],
+            ["app1.example.com", "app2.example.com", "app3.example.com"],
+            "default",
+        )
+        assert created
+        # three hostnames, ONE zone list (the snapshot is shared and
+        # the driver's own change batches are folded back in)
+        assert count_ops(backend, "ListResourceRecordSets") == before + 1
+        assert len(backend.records_in_zone(zone.id)) == 6  # 3 x (TXT + A)
+        # out-of-band: someone repoints one A record
+        victim = next(
+            r for r in backend.records_in_zone(zone.id)
+            if r.type == "A" and r.name == "app2.example.com."
+        )
+        victim = ResourceRecordSet(
+            name=victim.name, type="A",
+            alias_target=AliasTarget(
+                dns_name="evil.example.net.", hosted_zone_id="Z2BJ6XQ5FK7U4H"
+            ),
+        )
+        backend.change_resource_record_sets(zone.id, [Change("UPSERT", victim)])
+        now[0] = 6.0  # next tick window: snapshot expired, tamper visible
+        driver.ensure_route53_for_service(
+            svc, svc.status.load_balancer.ingress[0],
+            ["app1.example.com", "app2.example.com", "app3.example.com"],
+            "default",
+        )
+        repaired = next(
+            r for r in backend.records_in_zone(zone.id)
+            if r.type == "A" and r.name == "app2.example.com."
+        )
+        assert "awsglobalaccelerator" in repaired.alias_target.dns_name
+
+    def test_stale_snapshot_create_conflict_invalidates_and_recovers(self):
+        """A CREATE against a stale-negative snapshot fails loudly at
+        AWS (InvalidChangeBatch), invalidates the zone, and the retry
+        re-reads — the HostedZoneCache repair shape."""
+        backend = FakeAWSBackend()
+        backend.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        zone = backend.add_hosted_zone("example.com")
+        records = RecordSetCache(ttl=1000.0)
+        driver = self.make_driver(backend, record_cache=records)
+        svc = make_lb_service()
+        self.converge(driver, svc)
+        # warm the snapshot while the zone is empty
+        driver.find_owned_a_record_sets(
+            type(zone)(id=zone.id, name=zone.name), "'owner'"
+        )
+        # a foreign actor creates a TXT at the name we are about to use
+        backend.change_resource_record_sets(
+            zone.id, [Change("CREATE", txt_record("app.example.com", '"foreign"'))]
+        )
+        with pytest.raises(AWSAPIError) as exc:
+            driver.ensure_route53_for_service(
+                svc, svc.status.load_balancer.ingress[0],
+                ["app.example.com"], "default",
+            )
+        assert exc.value.code == "InvalidChangeBatch"
+        # the failure invalidated the snapshot: the retry sees the
+        # foreign TXT and fails the same honest way a cache-less
+        # driver would (foreign records are never clobbered), while a
+        # repair of OUR OWN records now reads fresh state
+        snapshot = records.get(zone.id, lambda: backend.records_in_zone(zone.id))
+        assert any(r.type == "TXT" for r in snapshot)
+
+    def test_lb_coalescer_serves_driver_lookups(self):
+        backend = FakeAWSBackend()
+        backend.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        coalescer = LoadBalancerCoalescer(ttl=100.0, batch_window=0.0)
+        driver = self.make_driver(backend, lb_coalescer=coalescer)
+        first = driver.get_load_balancer(NLB_NAME)
+        second = driver.get_load_balancer(NLB_NAME)
+        assert first.load_balancer_arn == second.load_balancer_arn
+        assert count_ops(backend, "DescribeLoadBalancers") == 1
+        with pytest.raises(AWSAPIError):
+            driver.get_load_balancer("no-such-lb")

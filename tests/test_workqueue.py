@@ -1,6 +1,12 @@
 """Workqueue semantics tests: dedup, in-flight coalescing, delayed and
 rate-limited adds, shutdown — the client-go contract the reference's
-controllers rely on (SURVEY.md §2 row 5)."""
+controllers rely on (SURVEY.md §2 row 5).
+
+Timing-dependent behavior (delayed delivery ordering, token-bucket
+refill) is driven by a FakeClock through the injectable ``clock``
+seams instead of sleeping real wall time: the limiter/queue tests
+that used to burn ~0.4 s of sleeps now run in milliseconds and assert
+EXACT delivery times instead of sloppy real-clock bounds."""
 
 import threading
 import time
@@ -13,6 +19,24 @@ from agac_tpu.reconcile.workqueue import (
     MaxOfRateLimiter,
     RateLimitingQueue,
 )
+
+
+class FakeClock:
+    """A manually advanced monotonic clock.  ``advance`` optionally
+    kicks a queue's delay waker — a fake clock cannot make a real
+    ``Condition.wait`` return early, so tests poke the waker after
+    moving time (the ``kick_delays`` seam)."""
+
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float, queue: RateLimitingQueue | None = None) -> None:
+        self.now += dt
+        if queue is not None:
+            queue.kick_delays()
 
 
 @pytest.fixture
@@ -66,13 +90,21 @@ def test_get_timeout_returns_none_not_shutdown(queue):
     assert queue.get(timeout=0.01) == (None, False)
 
 
-def test_add_after_delivers_later(queue):
-    start = time.monotonic()
-    queue.add_after("later", 0.1)
-    assert queue.get(timeout=0.02) == (None, False)
-    item, shutdown = queue.get(timeout=2)
-    assert (item, shutdown) == ("later", False)
-    assert time.monotonic() - start >= 0.09
+def test_add_after_delivers_on_clock_advance():
+    """Fake-clock conversion of the old real-sleep delivers-later test:
+    the delay boundary is asserted EXACTLY (9.9 s: not yet; 10 s:
+    delivered) with no wall-time sleeping."""
+    clock = FakeClock()
+    queue = RateLimitingQueue(name="fake-clock", clock=clock)
+    try:
+        queue.add_after("later", 10.0)
+        assert len(queue) == 0
+        clock.advance(9.9, queue)
+        assert queue.get(timeout=0.05) == (None, False)  # not ready yet
+        clock.advance(0.1, queue)
+        assert queue.get(timeout=2) == ("later", False)
+    finally:
+        queue.shutdown()
 
 
 def test_add_after_zero_is_immediate(queue):
@@ -80,11 +112,21 @@ def test_add_after_zero_is_immediate(queue):
     assert queue.get(timeout=1) == ("now", False)
 
 
-def test_add_after_ordering(queue):
-    queue.add_after("slow", 0.15)
-    queue.add_after("fast", 0.02)
-    assert queue.get(timeout=2)[0] == "fast"
-    assert queue.get(timeout=2)[0] == "slow"
+def test_add_after_ordering():
+    """Fake-clock conversion of the old 0.15 s-sleep ordering test:
+    heap order is by ready time, not insertion order."""
+    clock = FakeClock()
+    queue = RateLimitingQueue(name="fake-clock", clock=clock)
+    try:
+        queue.add_after("slow", 15.0)
+        queue.add_after("fast", 2.0)
+        clock.advance(2.0, queue)
+        assert queue.get(timeout=2)[0] == "fast"
+        assert len(queue) == 0
+        clock.advance(13.0, queue)
+        assert queue.get(timeout=2)[0] == "slow"
+    finally:
+        queue.shutdown()
 
 
 def test_shutdown_unblocks_get(queue):
@@ -102,11 +144,13 @@ def test_shutdown_unblocks_get(queue):
     assert queue.shutting_down()
 
 
-def test_add_after_shutdown_is_noop(queue):
+def test_add_after_shutdown_is_noop():
+    clock = FakeClock()
+    queue = RateLimitingQueue(name="fake-clock", clock=clock)
     queue.shutdown()
     queue.add("x")
     queue.add_after("y", 0.01)
-    time.sleep(0.05)
+    clock.advance(1.0, queue)
     assert len(queue) == 0
 
 
@@ -140,6 +184,41 @@ def test_bucket_limiter_burst_then_throttle():
     assert limiter.when("x") == 0.0
     assert limiter.when("x") == 0.0
     assert limiter.when("x") > 0.0  # burst exhausted
+
+
+def test_bucket_refill_with_fake_clock():
+    """The injected clock drives refill deterministically: exact
+    reservation delays and exact recovery after simulated idle time —
+    previously only assertable by sleeping real wall seconds."""
+    clock = FakeClock()
+    limiter = BucketRateLimiter(qps=10.0, burst=2, clock=clock)
+    assert limiter.when("a") == 0.0
+    assert limiter.when("a") == 0.0
+    # bucket empty: each reservation queues exactly 0.1 s behind the last
+    assert limiter.when("a") == pytest.approx(0.1)
+    assert limiter.when("a") == pytest.approx(0.2)
+    # 1 s of simulated idle refills to the burst cap (not beyond):
+    # 2 tokens deep in debt + 10 tokens refilled, capped at burst=2
+    clock.advance(1.0)
+    assert limiter.when("a") == 0.0
+    assert limiter.when("a") == 0.0
+    assert limiter.when("a") == pytest.approx(0.1)
+
+
+def test_controller_rate_limiter_bucket_refills_on_fake_clock():
+    """The clock threads through controller_rate_limiter to its
+    bucket: after simulated idle, the bucket contributes nothing and
+    only the per-item exponential backoff remains."""
+    from agac_tpu.reconcile import controller_rate_limiter
+
+    clock = FakeClock()
+    limiter = controller_rate_limiter(qps=1.0, burst=1, clock=clock)
+    assert limiter.when("x") == pytest.approx(0.005)  # burst token + 5 ms base
+    # burst spent: the 1 qps bucket dominates the 10 ms exponential
+    assert limiter.when("x") == pytest.approx(1.0)
+    clock.advance(10.0)
+    # refilled: the exponential (now 2^2 * 5 ms) is the only delay
+    assert limiter.when("x") == pytest.approx(0.02)
 
 
 def test_max_of_rate_limiter():
