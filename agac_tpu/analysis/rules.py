@@ -29,6 +29,11 @@ stack — the classes ruff's pyflakes-tier cannot express:
   stray raw read in an ensure/verify path silently reintroduces the
   O(N)-calls-per-tick regression the read plane exists to kill, and
   nothing else fails — the fleet just pays 4x the quota again.
+- ``unbounded-poll-loop`` — any while/sleep poll loop in
+  ``cloudprovider/`` or ``controllers/`` must consult a deadline or
+  the health plane (ISSUE 3): an unbounded poll against a wedged
+  backend holds its worker forever with no signal — exactly the
+  180 s-settle-poll wedge the reconcile deadline exists to cut.
 
 Suppression: append ``# agac-lint: ignore[rule-id] -- justification``
 to the offending line.  The justification is mandatory.
@@ -39,7 +44,7 @@ from __future__ import annotations
 import ast
 import re
 import sys
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Iterator, Optional
 
@@ -436,6 +441,64 @@ def check_drift_read_outside_read_plane(
             "(AcceleratorTopologyCache / RecordSetCache / "
             "LoadBalancerCoalescer) or add it to _READ_PLANE_FUNCS with "
             "justification",
+        )
+
+
+# ---------------------------------------------------------------------------
+# unbounded-poll-loop
+# ---------------------------------------------------------------------------
+
+# sleep-ish call targets: time.sleep, an injected self._sleep seam, a
+# bare sleep(...) name
+_SLEEPISH = re.compile(r"sleep", re.IGNORECASE)
+# what counts as consulting a bound: a deadline variable/comparison
+# (`deadline`, `check_deadline`, `deadline_remaining`) or the health
+# plane (`health`, `api_health`, a breaker/circuit handle)
+_DEADLINEISH = re.compile(r"deadline|health|circuit|breaker", re.IGNORECASE)
+
+
+def _call_target_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+@rule(
+    "unbounded-poll-loop",
+    "a while/sleep poll loop in cloudprovider/ or controllers/ must consult "
+    "a deadline or the health plane — an unbounded poll wedges its worker",
+)
+def check_unbounded_poll_loop(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
+    parts = ctx.path.parts
+    if "cloudprovider" not in parts and "controllers" not in parts:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While):
+            continue
+        sleeps = any(
+            isinstance(inner, ast.Call)
+            and (name := _call_target_name(inner)) is not None
+            and _SLEEPISH.search(name)
+            for inner in ast.walk(node)
+        )
+        if not sleeps:
+            continue
+        consults = any(
+            (isinstance(inner, ast.Name) and _DEADLINEISH.search(inner.id))
+            or (isinstance(inner, ast.Attribute) and _DEADLINEISH.search(inner.attr))
+            for inner in ast.walk(node)
+        )
+        if consults:
+            continue
+        yield Violation(
+            "unbounded-poll-loop",
+            str(ctx.path),
+            node.lineno,
+            "poll loop sleeps without consulting a deadline or the health "
+            "plane — a wedged backend holds this worker forever; check "
+            "`api_health.check_deadline(...)` (or a local deadline) each turn",
         )
 
 

@@ -44,7 +44,16 @@ from .cache import (
     RecordSetCache,
 )
 from .driver import AWSDriver, Route53OwnerValue
-from .fake_backend import FakeAWSBackend
+from .fake_backend import FakeAWSBackend, FaultPlan
+from .health import (
+    AIMDLimiter,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    HealthConfig,
+    HealthTracker,
+    WorkerHeartbeats,
+)
 
 __all__ = [
     "Accelerator",
@@ -71,6 +80,14 @@ __all__ = [
     "AWSDriver",
     "Route53OwnerValue",
     "FakeAWSBackend",
+    "FaultPlan",
+    "AIMDLimiter",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadlineExceeded",
+    "HealthConfig",
+    "HealthTracker",
+    "WorkerHeartbeats",
     "DiscoveryCache",
     "HostedZoneCache",
     "AcceleratorTopologyCache",

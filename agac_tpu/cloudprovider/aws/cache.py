@@ -180,9 +180,19 @@ class HostedZoneCache:
 
 
 class DiscoveryCache:
-    def __init__(self, ttl: float = 5.0, clock: Callable[[], float] = time.monotonic):
+    def __init__(
+        self,
+        ttl: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+        degraded: Optional[Callable[[], bool]] = None,
+    ):
         self._ttl = ttl
         self._clock = clock
+        # health-plane hook (factory wires it to "is the GA circuit
+        # open"): while True, an expired snapshot is served stale
+        # instead of dispatching a reload that is known to fail —
+        # bounded staleness beats a guaranteed error during a brownout
+        self._degraded = degraded
         self._lock = threading.Lock()
         self._snapshot: Optional[Snapshot] = None
         self._expires = 0.0
@@ -195,10 +205,16 @@ class DiscoveryCache:
         self.hits = 0
         self.misses = 0
         self.waits = 0  # callers that parked behind another's load
+        self.stale_serves = 0  # expired snapshots served while degraded
 
     def stats(self) -> dict:
         with self._lock:
-            return {"hits": self.hits, "misses": self.misses, "waits": self.waits}
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "waits": self.waits,
+                "stale_serves": self.stale_serves,
+            }
 
     def get(self, loader: Callable[[], Snapshot]) -> Snapshot:
         """Return the cached snapshot, loading through ``loader`` when
@@ -214,6 +230,13 @@ class DiscoveryCache:
             with self._lock:
                 if self._snapshot is not None and self._clock() < self._expires:
                     self.hits += 1
+                    return self._snapshot
+                if (
+                    self._snapshot is not None
+                    and self._degraded is not None
+                    and self._degraded()
+                ):
+                    self.stale_serves += 1
                     return self._snapshot
                 if self._load_event is None:
                     self._load_event = event = threading.Event()
@@ -600,9 +623,19 @@ class RecordSetCache:
     fold: changes applied while a load is in flight are replayed onto
     the loaded snapshot before it is stored."""
 
-    def __init__(self, ttl: float = 15.0, clock: Callable[[], float] = time.monotonic):
+    def __init__(
+        self,
+        ttl: float = 15.0,
+        clock: Callable[[], float] = time.monotonic,
+        degraded: Optional[Callable[[], bool]] = None,
+    ):
         self._ttl = ttl
         self._clock = clock
+        # health-plane hook (factory wires it to "is the Route53
+        # circuit open"): serve expired zone snapshots stale while the
+        # service is down instead of dispatching doomed reloads —
+        # degraded drift verification with bounded staleness
+        self._degraded = degraded
         self._lock = threading.Lock()
         # zone id -> (snapshot, expires) / in-flight (event, journal)
         self._snapshots: dict[str, tuple[list[ResourceRecordSet], float]] = {}
@@ -610,6 +643,7 @@ class RecordSetCache:
         self.hits = 0
         self.misses = 0
         self.waits = 0
+        self.stale_serves = 0  # expired snapshots served while degraded
 
     def stats(self) -> dict:
         with self._lock:
@@ -618,6 +652,7 @@ class RecordSetCache:
                 "misses": self.misses,
                 "waits": self.waits,
                 "zones": len(self._snapshots),
+                "stale_serves": self.stale_serves,
             }
 
     def get(
@@ -628,6 +663,13 @@ class RecordSetCache:
                 cached = self._snapshots.get(zone_id)
                 if cached is not None and self._clock() < cached[1]:
                     self.hits += 1
+                    return cached[0]
+                if (
+                    cached is not None
+                    and self._degraded is not None
+                    and self._degraded()
+                ):
+                    self.stale_serves += 1
                     return cached[0]
                 in_flight = self._loading.get(zone_id)
                 if in_flight is None:

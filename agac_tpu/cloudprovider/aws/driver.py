@@ -35,6 +35,7 @@ import time
 from typing import Callable, Optional
 
 from ... import apis, klog
+from . import health as api_health
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
 from .errors import (
     ERR_ACCELERATOR_NOT_FOUND,
@@ -858,7 +859,13 @@ class AWSDriver:
 
     def _delete_accelerator(self, arn: str) -> None:
         """Disable → poll until DEPLOYED → delete
-        (reference ``global_accelerator.go:724-765``; 10 s / 3 min poll)."""
+        (reference ``global_accelerator.go:724-765``; 10 s / 3 min poll).
+
+        The poll consults the worker's reconcile deadline (health
+        plane) each turn: an accelerator that never settles raises the
+        retryable DeadlineExceeded instead of holding the worker for
+        the full poll timeout, and the sleep never overshoots what is
+        left on the deadline."""
         klog.infof("Disabling Global Accelerator %s", arn)
         self.ga.update_accelerator(arn, enabled=False)
         self._invalidate_discovery()
@@ -874,10 +881,15 @@ class AWSDriver:
                 raise AWSAPIError(
                     "Timeout", f"accelerator {arn} did not settle within {self._poll_timeout}s"
                 )
+            api_health.check_deadline(f"settle poll for accelerator {arn}")
             klog.infof(
                 "Global Accelerator %s is %s, so waiting", arn, accelerator.status
             )
-            self._sleep(self._poll_interval)
+            wait = self._poll_interval
+            remaining = api_health.deadline_remaining()
+            if remaining is not None:
+                wait = min(wait, max(remaining, 0.0))
+            self._sleep(wait)
         self.ga.delete_accelerator(arn)
         self._discovery_remove(arn)
         klog.infof("Global Accelerator is deleted: %s", arn)

@@ -39,10 +39,13 @@ from .cache import (
 )
 from .driver import AWSDriver
 from .fake_backend import FakeAWSBackend
+from .health import ELBV2_OPS, GA_OPS, ROUTE53_OPS, HealthConfig, HealthTracker
 from .load_balancer import get_lb_name_from_hostname
 
 _fake_backend: FakeAWSBackend | None = None
 _lock = threading.Lock()
+# process-wide API health plane (circuit breakers + AIMD pacing)
+_health_tracker: HealthTracker | None = None
 # process-wide cache singletons shared by the per-reconcile drivers
 _discovery_cache: DiscoveryCache | None = None
 _zone_cache: HostedZoneCache | None = None
@@ -89,6 +92,71 @@ def configure_read_plane(ttl: float | None) -> None:
         _ttl_overrides[name] = ttl
 
 
+def configure_api_health(
+    window: float | None = None,
+    failure_ratio: float | None = None,
+    min_calls: float | None = None,
+    open_duration: float | None = None,
+    probe_budget: float | None = None,
+    aimd_qps: float | None = None,
+) -> None:
+    """Pin the API health plane knobs from the CLI (``--api-health-*``
+    flags); ``None`` keeps the per-knob environment variables /
+    defaults.  window 0 disables the whole plane (reference-parity
+    fixed-rate retries)."""
+    for name, value in (
+        ("AGAC_API_HEALTH_WINDOW", window),
+        ("AGAC_API_HEALTH_FAILURE_RATIO", failure_ratio),
+        ("AGAC_API_HEALTH_MIN_CALLS", min_calls),
+        ("AGAC_API_HEALTH_OPEN_DURATION", open_duration),
+        ("AGAC_API_HEALTH_PROBE_BUDGET", probe_budget),
+        ("AGAC_API_HEALTH_AIMD_QPS", aimd_qps),
+    ):
+        if value is not None:
+            _ttl_overrides[name] = value
+
+
+def shared_health_tracker() -> HealthTracker | None:
+    """The process-wide health tracker, or None when disabled
+    (``AGAC_API_HEALTH_WINDOW=0``).  Knob table in docs/operations.md
+    "API health plane"."""
+    global _health_tracker
+    # 30 s rolling window / 50% failure ratio over >= 10 calls: wide
+    # enough that one unlucky burst of throttles never trips the
+    # breaker, tight enough that a real brownout opens it within one
+    # drift verify round
+    window = _env_float("AGAC_API_HEALTH_WINDOW", 30.0)
+    if window <= 0:
+        return None
+    with _lock:
+        if _health_tracker is None:
+            _health_tracker = HealthTracker(
+                HealthConfig(
+                    window=window,
+                    min_calls=int(_env_float("AGAC_API_HEALTH_MIN_CALLS", 10)),
+                    failure_ratio=_env_float("AGAC_API_HEALTH_FAILURE_RATIO", 0.5),
+                    # 15 s open: long enough to actually shed load,
+                    # short enough that recovery is noticed within one
+                    # requeue interval
+                    open_duration=_env_float("AGAC_API_HEALTH_OPEN_DURATION", 15.0),
+                    probe_budget=int(_env_float("AGAC_API_HEALTH_PROBE_BUDGET", 1)),
+                    # AIMD ceiling: 20 calls/s per service per process
+                    # (comfortably above steady-state need; the point
+                    # is the multiplicative cut under throttling)
+                    aimd_qps=_env_float("AGAC_API_HEALTH_AIMD_QPS", 20.0),
+                )
+            )
+        return _health_tracker
+
+
+def api_health_stats() -> dict:
+    """Per-circuit state + outcome counters — the observability hook
+    the manager's /readyz endpoint and the bench export."""
+    with _lock:
+        tracker = _health_tracker
+    return tracker.snapshot() if tracker is not None else {}
+
+
 def _discovery_cache_ttl() -> float:
     # 30 s default: the write journal (cache.py) makes the TTL a pure
     # cross-process staleness bound — local writes are always visible —
@@ -122,9 +190,20 @@ def _shared_discovery_cache() -> DiscoveryCache | None:
     ttl = _discovery_cache_ttl()
     if ttl <= 0:
         return None
+    tracker = shared_health_tracker()
     with _lock:
         if _discovery_cache is None:
-            _discovery_cache = DiscoveryCache(ttl=ttl)
+            _discovery_cache = DiscoveryCache(
+                ttl=ttl,
+                # degraded mode: with the GA circuit open, serve the
+                # expired discovery snapshot stale rather than dispatch
+                # a doomed O(N) rescan (staleness bound: the outage)
+                degraded=(
+                    (lambda: tracker.is_open("globalaccelerator"))
+                    if tracker is not None
+                    else None
+                ),
+            )
         return _discovery_cache
 
 
@@ -156,9 +235,19 @@ def _shared_record_cache() -> RecordSetCache | None:
     ttl = _env_float("AGAC_RECORDSET_CACHE_TTL", 15.0)
     if ttl <= 0:
         return None
+    tracker = shared_health_tracker()
     with _lock:
         if _record_cache is None:
-            _record_cache = RecordSetCache(ttl=ttl)
+            _record_cache = RecordSetCache(
+                ttl=ttl,
+                # degraded mode: with the Route53 circuit open, serve
+                # expired zone snapshots stale (see DiscoveryCache)
+                degraded=(
+                    (lambda: tracker.is_open("route53"))
+                    if tracker is not None
+                    else None
+                ),
+            )
         return _record_cache
 
 
@@ -228,6 +317,21 @@ def read_plane_stats() -> dict:
     return stats
 
 
+def _guarded_handles(ga, elbv2, route53, region: str):
+    """Wrap the three service handles in the health plane's guards
+    (circuit gate + AIMD pacing + outcome classification); pass-through
+    when the plane is disabled.  GA and Route53 are global endpoints —
+    one circuit each; ELBv2 is regional — one circuit per region."""
+    tracker = shared_health_tracker()
+    if tracker is None:
+        return ga, elbv2, route53
+    return (
+        tracker.guard(ga, "globalaccelerator", GA_OPS),
+        tracker.guard(elbv2, f"elbv2[{region}]", ELBV2_OPS),
+        tracker.guard(route53, "route53", ROUTE53_OPS),
+    )
+
+
 def real_cloud_factory(region: str) -> AWSDriver:
     caches = dict(
         discovery_cache=_shared_discovery_cache(),
@@ -238,8 +342,20 @@ def real_cloud_factory(region: str) -> AWSDriver:
     )
     if os.environ.get("AGAC_CLOUD") == "fake":
         backend = shared_fake_backend()
-        return AWSDriver(backend, backend, backend, **caches)
+        ga, elbv2, route53 = _guarded_handles(backend, backend, backend, region)
+        return AWSDriver(ga, elbv2, route53, **caches)
     from .real_backend import RealAWSClients
 
     clients = RealAWSClients.from_environment(region)
-    return AWSDriver(clients.ga, clients.elbv2, clients.route53, **caches)
+    tracker = shared_health_tracker()
+    if tracker is not None:
+        # the in-client retry loop reports per-attempt throttle/5xx
+        # classifications, so a brownout the 3-attempt retries keep
+        # absorbing still drives the AIMD limiter down
+        clients.ga.set_outcome_hook(tracker.service("globalaccelerator").record)
+        clients.elbv2.set_outcome_hook(tracker.service(f"elbv2[{region}]").record)
+        clients.route53.set_outcome_hook(tracker.service("route53").record)
+    ga, elbv2, route53 = _guarded_handles(
+        clients.ga, clients.elbv2, clients.route53, region
+    )
+    return AWSDriver(ga, elbv2, route53, **caches)

@@ -34,9 +34,13 @@ reproduced because the drivers depend on them:
 
 from __future__ import annotations
 
+import inspect
 import itertools
+import random
 import re
+import threading
 import uuid
+from collections import deque
 from dataclasses import replace
 from typing import Optional
 
@@ -146,6 +150,203 @@ def _paginate(items: list, max_results: int, next_token: Optional[str]):
     return page, token
 
 
+# every method the drivers can reach — exactly the three API
+# interfaces, so test helpers (add_load_balancer, records_in_zone, ...)
+# stay fault-free under an installed FaultPlan
+API_OPS = frozenset(
+    name
+    for cls in (GlobalAcceleratorAPI, ELBv2API, Route53API)
+    for name, member in vars(cls).items()
+    if inspect.isfunction(member) and not name.startswith("_")
+)
+
+_MUTATING_PREFIXES = (
+    "create_", "update_", "delete_", "add_", "remove_", "tag_", "change_",
+)
+
+
+class _Fault:
+    """One scripted fault: ``kind`` is fail / commit-then-fail / hang;
+    ``remaining`` counts down to exhaustion."""
+
+    __slots__ = ("kind", "code", "remaining")
+
+    def __init__(self, kind: str, code: str, remaining: int):
+        self.kind = kind
+        self.code = code
+        self.remaining = remaining
+
+
+class FaultPlan:
+    """First-class fault injection for ``FakeAWSBackend`` — the
+    promotion of the chaos tier's ad-hoc ``__getattribute__`` subclass
+    hooks into one scripted API (ISSUE 3 satellite).  Three layers,
+    consulted in order for every API call from a non-exempt thread:
+
+    1. **scripted schedules** per op (FIFO): ``throttle(op, times)``,
+       ``fail(op, times, code)``, ``fail_after_commit(op, times)`` (the
+       ambiguous-timeout shape: the change commits, the caller sees an
+       error), ``hang_until_deadline(op)`` (the call blocks until the
+       calling worker's reconcile deadline expires, then surfaces a
+       timeout — the wedge shape the deadline machinery exists to cut);
+    2. **outages**: ``outage(*ops)`` fails every call until
+       ``restore()`` — the sustained-brownout shape the circuit
+       breaker reacts to;
+    3. **chaos**: ``chaos(seed, fault_budget, p, ambiguous)`` — the
+       seeded randomized mode the chaos e2e tier runs (finite budget,
+       so every run terminates).
+
+    The thread that builds the plan is exempt by default so test
+    assertion predicates read clean truth through the same API.
+    ``faults_served`` / ``served_by_op`` count injected faults —
+    during an outage they equal the calls attempted against the dead
+    service, which is what the brownout call-budget assertions bound.
+    """
+
+    def __init__(self, exempt_creator: bool = True):
+        self._lock = threading.Lock()
+        self._scripts: dict[str, deque[_Fault]] = {}
+        self._outages: dict[str, str] = {}  # op -> error code
+        self._rng: Optional[random.Random] = None
+        self._p = 0.0
+        self._ambiguous = 0.0
+        self.fault_budget = 0
+        self.faults_served = 0
+        self.served_by_op: dict[str, int] = {}
+        self._exempt: set = {threading.current_thread()} if exempt_creator else set()
+        # safety valve for hang_until_deadline when no deadline is
+        # armed: never block a call longer than this
+        self.max_hang = 30.0
+
+    # -- scripted schedules -------------------------------------------------
+    def _script(self, op: str, kind: str, code: str, times: int) -> "FaultPlan":
+        if op not in API_OPS:
+            raise ValueError(f"unknown API op {op!r}")
+        with self._lock:
+            self._scripts.setdefault(op, deque()).append(_Fault(kind, code, times))
+        return self
+
+    def throttle(self, op: str, times: int = 1, code: str = "ThrottlingException") -> "FaultPlan":
+        return self._script(op, "fail", code, times)
+
+    def fail(self, op: str, times: int = 1, code: str = "InternalFailure") -> "FaultPlan":
+        return self._script(op, "fail", code, times)
+
+    def fail_after_commit(self, op: str, times: int = 1, code: str = "RequestTimeout") -> "FaultPlan":
+        return self._script(op, "commit-then-fail", code, times)
+
+    def hang_until_deadline(self, op: str, times: int = 1) -> "FaultPlan":
+        return self._script(op, "hang", "RequestTimeout", times)
+
+    # -- sustained outage ---------------------------------------------------
+    def outage(self, *ops: str, code: str = "ServiceUnavailable") -> "FaultPlan":
+        unknown = [op for op in ops if op not in API_OPS]
+        if unknown:
+            raise ValueError(f"unknown API ops {unknown!r}")
+        with self._lock:
+            for op in ops:
+                self._outages[op] = code
+        return self
+
+    def restore(self, *ops: str) -> "FaultPlan":
+        """End an outage for the given ops (none = all)."""
+        with self._lock:
+            if ops:
+                for op in ops:
+                    self._outages.pop(op, None)
+            else:
+                self._outages.clear()
+        return self
+
+    # -- randomized chaos ---------------------------------------------------
+    def chaos(
+        self, seed: int, fault_budget: int, p: float = 0.25, ambiguous: float = 0.4
+    ) -> "FaultPlan":
+        """Any API call may fail with a retryable error at probability
+        ``p`` while the budget lasts; mutating ops additionally fail
+        *after* committing with conditional probability ``ambiguous``."""
+        with self._lock:
+            self._rng = random.Random(seed)
+            self._p = p
+            self._ambiguous = ambiguous
+            self.fault_budget = fault_budget
+        return self
+
+    def refill(self, budget: int) -> None:
+        with self._lock:
+            self.fault_budget = budget
+
+    # -- bookkeeping --------------------------------------------------------
+    def exempt(self, thread: Optional[threading.Thread] = None) -> "FaultPlan":
+        with self._lock:
+            self._exempt.add(thread or threading.current_thread())
+        return self
+
+    def faults_for(self, *ops: str) -> int:
+        with self._lock:
+            return sum(self.served_by_op.get(op, 0) for op in ops)
+
+    def _serve(self, op: str) -> None:
+        self.faults_served += 1
+        self.served_by_op[op] = self.served_by_op.get(op, 0) + 1
+
+    # -- the engine ---------------------------------------------------------
+    def _decide(self, op: str) -> Optional[tuple[str, str]]:
+        """(kind, code) to inject for this call, or None."""
+        if threading.current_thread() in self._exempt:
+            return None
+        with self._lock:
+            schedule = self._scripts.get(op)
+            while schedule:
+                fault = schedule[0]
+                if fault.remaining <= 0:
+                    schedule.popleft()
+                    continue
+                fault.remaining -= 1
+                self._serve(op)
+                return fault.kind, fault.code
+            code = self._outages.get(op)
+            if code is not None:
+                self._serve(op)
+                return "fail", code
+            if self._rng is not None and self.fault_budget > 0:
+                if self._rng.random() < self._p:
+                    self.fault_budget -= 1
+                    self._serve(op)
+                    if op.startswith(_MUTATING_PREFIXES) and self._rng.random() < self._ambiguous:
+                        return "commit-then-fail", "RequestTimeout"
+                    return "fail", "ThrottlingException"
+        return None
+
+    def _hang(self, op: str) -> None:
+        """Block like a wedged backend call, bounded by the calling
+        worker's reconcile deadline (health plane) or ``max_hang``,
+        then surface the timeout shape a real stuck call produces."""
+        from .health import deadline_remaining
+
+        remaining = deadline_remaining()
+        wait = self.max_hang if remaining is None else min(remaining + 0.05, self.max_hang)
+        if wait > 0:
+            threading.Event().wait(wait)
+        raise AWSAPIError("RequestTimeout", f"fault plan: {op} hung past deadline")
+
+    def wrap(self, op: str, call):
+        def faulted(*args, **kwargs):
+            fate = self._decide(op)
+            if fate is None:
+                return call(*args, **kwargs)
+            kind, code = fate
+            if kind == "hang":
+                self._hang(op)
+            if kind == "fail":
+                raise AWSAPIError(code, f"fault plan: {op}")
+            result = call(*args, **kwargs)  # commit-then-fail
+            del result
+            raise AWSAPIError(code, f"fault plan (after commit): {op}")
+
+        return faulted
+
+
 class _AcceleratorState:
     def __init__(self, accelerator: Accelerator, tags: list[Tag], settle: int):
         self.accelerator = accelerator
@@ -204,6 +405,27 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         self._counter = itertools.count(1)
         # call log for assertions ("CreateAccelerator", arn), ...
         self.calls: list[tuple] = []
+        # first-class fault injection (see FaultPlan); None = clean
+        self.fault_plan: Optional[FaultPlan] = None
+
+    def install_fault_plan(self, plan: Optional[FaultPlan] = None) -> FaultPlan:
+        """Attach a FaultPlan (building one if not given) and return
+        it; every subsequent API call from a non-exempt thread consults
+        it.  Replaces the old pattern of ad-hoc ``__getattribute__``
+        subclasses in the chaos/resilience tiers."""
+        self.fault_plan = plan if plan is not None else FaultPlan()
+        return self.fault_plan
+
+    def __getattribute__(self, name):
+        attr = super().__getattribute__(name)
+        if name in API_OPS:
+            # __dict__ lookup, not self.fault_plan: attribute access
+            # here would recurse, and during __init__ the slot may not
+            # exist yet
+            plan = super().__getattribute__("__dict__").get("fault_plan")
+            if plan is not None:
+                return plan.wrap(name, attr)
+        return attr
 
     # ------------------------------------------------------------------
     # test helpers

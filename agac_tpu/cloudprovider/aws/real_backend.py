@@ -42,6 +42,14 @@ from .errors import (
     EndpointGroupNotFoundException,
     ListenerNotFoundException,
 )
+from .health import (
+    OUTCOME_CONNECTION_ERROR,
+    OUTCOME_SERVER_ERROR,
+    OUTCOME_THROTTLE,
+    THROTTLE_CODES,
+    DeadlineExceeded,
+    deadline_remaining,
+)
 from .sigv4 import Credentials, CredentialProvider, sign_request, xml_strip_ns
 from .types import (
     Accelerator,
@@ -179,11 +187,31 @@ class _SignedClient:
         self._attempts = max(1, attempts)
         self._sleep = sleep if sleep is not None else time.sleep
         self._error_code = error_code_parser
+        # health-plane seam: called with an outcome classification for
+        # every RETRIED attempt (throttle / server-error / connection-
+        # error).  The guard layer above the API objects only sees the
+        # final result, so without this hook a brownout the in-client
+        # retries keep absorbing would be invisible to the AIMD
+        # limiter until it overflowed the attempt budget.
+        self.on_outcome: Optional[Callable[[str], None]] = None
 
     def _retryable(self, status: int, body: bytes) -> bool:
         if status in _RETRYABLE_STATUSES:
             return True
         return status >= 400 and self._error_code(body) in RETRYABLE_CODES
+
+    def _report(self, outcome: str) -> None:
+        if self.on_outcome is not None:
+            try:
+                self.on_outcome(outcome)
+            except Exception as err:  # observability must not fail the call
+                klog.errorf("health outcome hook failed: %s", err)
+
+    def _attempt_outcome(self, status: int, body: bytes) -> str:
+        code = self._error_code(body)
+        if status == 429 or code in THROTTLE_CODES:
+            return OUTCOME_THROTTLE
+        return OUTCOME_SERVER_ERROR
 
     def request(
         self, method: str, path: str, headers: dict[str, str], body: bytes
@@ -193,11 +221,20 @@ class _SignedClient:
         for attempt in range(self._attempts):
             if attempt:
                 # full jitter keeps a fleet of workers from thundering
-                self._sleep(
-                    random.uniform(
-                        0, min(RETRY_MAX_DELAY, RETRY_BASE_DELAY * (2 ** attempt))
-                    )
+                backoff = random.uniform(
+                    0, min(RETRY_MAX_DELAY, RETRY_BASE_DELAY * (2 ** attempt))
                 )
+                # the retry checks the reconcile deadline (health
+                # plane): no point burning a backoff sleep plus another
+                # attempt the caller can no longer use — surface the
+                # retryable deadline error and free the worker
+                remaining = deadline_remaining()
+                if remaining is not None and remaining <= backoff:
+                    raise DeadlineExceeded(
+                        f"{method} {path}: reconcile deadline expired "
+                        f"before retry {attempt + 1}/{self._attempts}"
+                    )
+                self._sleep(backoff)
             # re-sign every attempt: fresh timestamp, and the provider
             # refreshes expiring session credentials (IRSA) transparently
             signed = sign_request(
@@ -217,12 +254,14 @@ class _SignedClient:
                 # driver path already treats as absence; the rest are
                 # reads.
                 last_exc = err
+                self._report(OUTCOME_CONNECTION_ERROR)
                 klog.v(2).infof(
                     "retrying %s %s after connection error (%s, attempt %d/%d)",
                     method, path, err, attempt + 1, self._attempts,
                 )
                 continue
             if attempt + 1 < self._attempts and self._retryable(status, payload):
+                self._report(self._attempt_outcome(status, payload))
                 klog.v(2).infof(
                     "retrying %s %s after HTTP %d (attempt %d/%d)",
                     method, path, status, attempt + 1, self._attempts,
@@ -329,6 +368,10 @@ class RealGlobalAcceleratorAPI(GlobalAcceleratorAPI):
             sleep=sleep,
             error_code_parser=_ga_error_code,
         )
+
+    def set_outcome_hook(self, hook) -> None:
+        """Feed per-retry outcome classifications to the health plane."""
+        self._client.on_outcome = hook
 
     def _call(self, operation: str, payload: dict, parse=None):
         """POST one JSON-1.1 operation.  ``parse`` maps the decoded
@@ -616,6 +659,10 @@ class RealELBv2API(ELBv2API):
             sleep=sleep,
         )
 
+    def set_outcome_hook(self, hook) -> None:
+        """Feed per-retry outcome classifications to the health plane."""
+        self._client.on_outcome = hook
+
     # DescribeLoadBalancers accepts at most 20 names per request
     # (ELBv2 API reference); the read plane's coalescer batches up to
     # exactly this, but a direct caller with a wider list must not get
@@ -732,6 +779,10 @@ class RealRoute53API(Route53API):
             attempts=attempts,
             sleep=sleep,
         )
+
+    def set_outcome_hook(self, hook) -> None:
+        """Feed per-retry outcome classifications to the health plane."""
+        self._client.on_outcome = hook
 
     def _get(self, operation: str, expected_root: str, path: str) -> ET.Element:
         status, response = self._client.request("GET", path, {}, b"")

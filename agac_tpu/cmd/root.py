@@ -79,6 +79,52 @@ def build_parser() -> argparse.ArgumentParser:
         "retries; lower it to bound worst-case repair latency).",
     )
     controller.add_argument(
+        "--reconcile-deadline", type=float, default=300.0,
+        help="Per-item reconcile deadline in seconds: settle polls and "
+        "backend retry backoffs check it and requeue with a retryable "
+        "deadline error instead of wedging a worker. 0 disables "
+        "(reference parity: a poll can hold a worker its full timeout).",
+    )
+    controller.add_argument(
+        "--health-port", type=int, default=8081,
+        help="Port for the manager /healthz+/readyz endpoint (circuit "
+        "state + worker liveness, for deployment probes). 0 disables.",
+    )
+    controller.add_argument(
+        "--api-health-window", type=float, default=None,
+        help="Rolling classification window (seconds) of the per-service "
+        "API health tracker; 0 disables the whole health plane "
+        "(circuit breakers + AIMD pacing). Default 30 "
+        "(env AGAC_API_HEALTH_WINDOW).",
+    )
+    controller.add_argument(
+        "--api-health-failure-ratio", type=float, default=None,
+        help="Failure ratio over the window that opens a service "
+        "circuit. Default 0.5 (env AGAC_API_HEALTH_FAILURE_RATIO).",
+    )
+    controller.add_argument(
+        "--api-health-min-calls", type=int, default=None,
+        help="Minimum calls in the window before the ratio is "
+        "evaluated. Default 10 (env AGAC_API_HEALTH_MIN_CALLS).",
+    )
+    controller.add_argument(
+        "--api-health-open-duration", type=float, default=None,
+        help="Seconds an open circuit rejects calls before admitting "
+        "probe calls. Default 15 (env AGAC_API_HEALTH_OPEN_DURATION).",
+    )
+    controller.add_argument(
+        "--api-health-probe-budget", type=int, default=None,
+        help="Probe calls allowed per open-duration interval while "
+        "half-open. Default 1 (env AGAC_API_HEALTH_PROBE_BUDGET).",
+    )
+    controller.add_argument(
+        "--api-health-aimd-qps", type=float, default=None,
+        help="Ceiling of the per-service AIMD adaptive call rate; "
+        "throttle responses cut the live rate multiplicatively, "
+        "successes restore it additively. 0 disables pacing (circuit "
+        "breaking only). Default 20 (env AGAC_API_HEALTH_AIMD_QPS).",
+    )
+    controller.add_argument(
         "--read-plane-ttl", type=float, default=None,
         help="Tick scope (seconds) of the coalesced verification read "
         "plane: accelerator-topology, record-set and load-balancer "
@@ -155,6 +201,7 @@ def run_controller(args) -> int:
         "queue_burst": args.queue_burst,
         "queue_max_backoff": args.queue_max_backoff,
         "drift_resync_period": args.drift_resync_period,
+        "reconcile_deadline": args.reconcile_deadline,
     }
     config = ControllerConfig(
         global_accelerator=GlobalAcceleratorConfig(
@@ -169,12 +216,36 @@ def run_controller(args) -> int:
     )
     stop = setup_signal_handler()
 
-    from ..cloudprovider.aws.factory import configure_read_plane, real_cloud_factory
+    from ..cloudprovider.aws.factory import (
+        configure_api_health,
+        configure_read_plane,
+        real_cloud_factory,
+        shared_health_tracker,
+    )
 
     configure_read_plane(args.read_plane_ttl)
+    configure_api_health(
+        window=args.api_health_window,
+        failure_ratio=args.api_health_failure_ratio,
+        min_calls=args.api_health_min_calls,
+        open_duration=args.api_health_open_duration,
+        probe_budget=args.api_health_probe_budget,
+        aimd_qps=args.api_health_aimd_qps,
+    )
+    tracker = shared_health_tracker()
+
+    if args.health_port > 0:
+        from ..manager import make_health_server
+
+        health_server = make_health_server(args.health_port, health=tracker)
+        import threading
+
+        threading.Thread(
+            target=health_server.serve_forever, daemon=True, name="health-server"
+        ).start()
 
     def run_manager(stop_event):
-        Manager().run(
+        Manager(health=tracker).run(
             client, config, stop_event, cloud_factory=real_cloud_factory, block=True
         )
 

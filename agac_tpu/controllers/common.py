@@ -17,8 +17,9 @@ from typing import Any, Callable, Optional
 
 from .. import apis, klog
 from ..cloudprovider.aws import AWSDriver, get_lb_name_from_hostname
+from ..cloudprovider.aws.health import CircuitOpenError
 from ..cluster.informer import Tombstone
-from ..reconcile import RateLimitingQueue, process_next_work_item
+from ..reconcile import RateLimitingQueue, Result, process_next_work_item
 
 # One driver per region; GA/Route53 are global services pinned to
 # us-west-2 in the reference (``pkg/cloudprovider/aws/aws.go:26-32``).
@@ -84,6 +85,36 @@ def unwrap_tombstone(obj: Any) -> Optional[Any]:
 # ---------------------------------------------------------------------------
 
 
+# floor on circuit-aware requeues: the breaker's hint can be tiny at
+# the open→half-open boundary, and a sub-second requeue would spin the
+# queue against a service that is still down
+CIRCUIT_RETRY_FLOOR = 1.0
+
+
+def with_circuit_backoff(process):
+    """Wrap a process func so an open circuit (API health plane) is a
+    clean degraded-mode requeue at the breaker's retry hint instead of
+    an anonymous rate-limited failure: the item keeps its backoff
+    state, the queue stops feeding the dead service, and the retry
+    lands right when the breaker will admit a probe."""
+
+    def wrapped(arg):
+        try:
+            return process(arg)
+        except CircuitOpenError as err:
+            klog.warningf(
+                "%s circuit is open; degraded mode, requeueing in %.1fs",
+                err.service, max(err.retry_after, CIRCUIT_RETRY_FLOOR),
+            )
+            return Result(
+                requeue=True,
+                requeue_after=max(err.retry_after, CIRCUIT_RETRY_FLOOR),
+            )
+
+    wrapped.__name__ = getattr(process, "__name__", "process")
+    return wrapped
+
+
 def run_workers(
     name: str,
     queue: RateLimitingQueue,
@@ -93,16 +124,24 @@ def run_workers(
     process_delete,
     process_create_or_update,
     on_sync_result=None,
+    reconcile_deadline: float | None = None,
 ) -> list[threading.Thread]:
     """Launch ``threadiness`` worker threads looping
     ``process_next_work_item`` until queue shutdown (the analog of
     ``wait.Until(runWorker, time.Second, stopCh)``,
-    reference ``globalaccelerator/controller.go:206-211``)."""
+    reference ``globalaccelerator/controller.go:206-211``).
+
+    Both process funcs are wrapped circuit-aware (see
+    ``with_circuit_backoff``), and ``reconcile_deadline`` arms the
+    per-item deadline the driver's poll loops and backend retries
+    consult (health plane; None/0 disables)."""
+    process_delete = with_circuit_backoff(process_delete)
+    process_create_or_update = with_circuit_backoff(process_create_or_update)
 
     def loop():
         while process_next_work_item(
             queue, key_to_obj, process_delete, process_create_or_update,
-            on_sync_result,
+            on_sync_result, reconcile_deadline=reconcile_deadline,
         ):
             if stop.is_set():
                 break

@@ -64,9 +64,14 @@ class EndpointGroupBindingConfig:
     queue_max_backoff: float = 1000.0
     # see GlobalAcceleratorConfig.drift_resync_period; 0 = reference parity
     drift_resync_period: float = 0.0
+    # see GlobalAcceleratorConfig.reconcile_deadline; 0 = disabled
+    reconcile_deadline: float = 0.0
 
 
 class EndpointGroupBindingController:
+    # endpoint membership lives in GA; LB resolution goes through ELBv2
+    DRIFT_SERVICES = ("globalaccelerator", "elbv2")
+
     def __init__(
         self,
         client: ClusterClient,
@@ -77,6 +82,7 @@ class EndpointGroupBindingController:
         self._client = client
         self._workers = config.workers
         self._drift_resync_period = config.drift_resync_period
+        self._reconcile_deadline = config.reconcile_deadline
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
         self.workqueue = RateLimitingQueue(
@@ -139,6 +145,7 @@ class EndpointGroupBindingController:
             self._process_deleted_key,
             self.reconcile,
             on_sync_result=make_sync_error_warner(self.recorder, self._key_to_binding),
+            reconcile_deadline=self._reconcile_deadline,
         )
         klog.info("Started workers")
         # plain dedup add, not add_rate_limited — see the

@@ -73,9 +73,19 @@ class GlobalAcceleratorConfig:
     # reference parity: equal resync updates are skipped and
     # out-of-band drift waits for an object change
     drift_resync_period: float = 0.0
+    # per-item reconcile deadline (seconds) armed by the worker loop:
+    # settle polls and backend retry backoffs consult it and raise the
+    # retryable DeadlineExceeded instead of wedging the worker (API
+    # health plane); 0 (default) disables
+    reconcile_deadline: float = 0.0
 
 
 class GlobalAcceleratorController:
+    # the AWS services this controller's reconciles/verify reads hit —
+    # the manager's drift tick skips this controller (tick marked
+    # partial) while any of their circuits is open
+    DRIFT_SERVICES = ("globalaccelerator", "elbv2")
+
     def __init__(
         self,
         client: ClusterClient,
@@ -86,6 +96,7 @@ class GlobalAcceleratorController:
         self.cluster_name = config.cluster_name
         self._workers = config.workers
         self._drift_resync_period = config.drift_resync_period
+        self._reconcile_deadline = config.reconcile_deadline
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
         self.service_queue = RateLimitingQueue(
@@ -212,6 +223,7 @@ class GlobalAcceleratorController:
             self.process_service_delete,
             self.process_service_create_or_update,
             on_sync_result=make_sync_error_warner(self.recorder, self._key_to_service),
+            reconcile_deadline=self._reconcile_deadline,
         )
         run_workers(
             f"{CONTROLLER_AGENT_NAME}-ingress",
@@ -222,6 +234,7 @@ class GlobalAcceleratorController:
             self.process_ingress_delete,
             self.process_ingress_create_or_update,
             on_sync_result=make_sync_error_warner(self.recorder, self._key_to_ingress),
+            reconcile_deadline=self._reconcile_deadline,
         )
         klog.info("Started workers")
         # resync ticks use the plain dedup add, NOT add_rate_limited:

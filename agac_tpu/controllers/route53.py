@@ -65,9 +65,15 @@ class Route53Config:
     queue_max_backoff: float = 1000.0
     # see GlobalAcceleratorConfig.drift_resync_period; 0 = reference parity
     drift_resync_period: float = 0.0
+    # see GlobalAcceleratorConfig.reconcile_deadline; 0 = disabled
+    reconcile_deadline: float = 0.0
 
 
 class Route53Controller:
+    # the accelerator is discovered through GA tags, the records live
+    # in Route53 — drift ticks for this controller need both healthy
+    DRIFT_SERVICES = ("route53", "globalaccelerator")
+
     def __init__(
         self,
         client: ClusterClient,
@@ -78,6 +84,7 @@ class Route53Controller:
         self.cluster_name = config.cluster_name
         self._workers = config.workers
         self._drift_resync_period = config.drift_resync_period
+        self._reconcile_deadline = config.reconcile_deadline
         self._cloud = cloud_factory or default_cloud_factory
         self.recorder = EventRecorder(client, CONTROLLER_AGENT_NAME)
         self.service_queue = RateLimitingQueue(
@@ -198,6 +205,7 @@ class Route53Controller:
             self.process_service_delete,
             self.process_service_create_or_update,
             on_sync_result=make_sync_error_warner(self.recorder, self._key_to_service),
+            reconcile_deadline=self._reconcile_deadline,
         )
         run_workers(
             f"{CONTROLLER_AGENT_NAME}-ingress",
@@ -208,6 +216,7 @@ class Route53Controller:
             self.process_ingress_delete,
             self.process_ingress_create_or_update,
             on_sync_result=make_sync_error_warner(self.recorder, self._key_to_ingress),
+            reconcile_deadline=self._reconcile_deadline,
         )
         klog.info("Started workers")
         # plain dedup add, not add_rate_limited — see the

@@ -1,4 +1,4 @@
-"""Controller manager: registry + lifecycle.
+"""Controller manager: registry + lifecycle + the health endpoint.
 
 Capability parity with the reference's ``pkg/manager/`` (136 LoC): a
 named registry of controller initializers, one shared informer factory
@@ -10,15 +10,28 @@ One difference by design: a single ``ClusterClient`` serves both the
 built-in kinds and the CRD (the reference needs two generated
 clientsets + two informer factories; the generic cluster layer makes
 that split unnecessary).
+
+Beyond the reference: the API health plane (ISSUE 3).  The manager
+optionally carries a ``HealthTracker``; ``drift_tick`` skips
+controllers whose backing service circuits are open (marking the tick
+partial instead of issuing verify reads into an outage), shutdown
+names the reconcile key any straggler thread is wedged on, a watchdog
+surfaces stuck workers, and ``make_health_server`` serves
+``/healthz`` + ``/readyz`` (stdlib server, same pattern as
+``webhook/server.py``) reporting per-circuit state and worker
+liveness for deployment probes.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from . import klog
+from .cloudprovider.aws import health as api_health
 from .cluster import ClusterClient, SharedInformerFactory
 from .controllers import (
     EndpointGroupBindingConfig,
@@ -31,6 +44,11 @@ from .controllers import (
 from .controllers.common import CloudFactory
 
 INFORMER_RESYNC_PERIOD = 30.0
+
+# a worker on one reconcile key longer than this is "stuck" for the
+# watchdog and /healthz (a healthy reconcile is seconds; the longest
+# legitimate hold is the 180 s settle poll)
+WORKER_STUCK_THRESHOLD = 300.0
 
 
 @dataclass
@@ -66,9 +84,20 @@ def new_controller_initializers() -> dict[str, InitFunc]:
 
 
 class Manager:
-    def __init__(self, resync_period: float = INFORMER_RESYNC_PERIOD):
+    def __init__(
+        self,
+        resync_period: float = INFORMER_RESYNC_PERIOD,
+        health: Optional["api_health.HealthTracker"] = None,
+        heartbeats: Optional["api_health.WorkerHeartbeats"] = None,
+    ):
         self._resync_period = resync_period
+        self._health = health
+        self.heartbeats = heartbeats or api_health.worker_heartbeats()
         self.controllers: dict[str, object] = {}
+        # what the last drift_tick did, for bench_detail.json and tests:
+        # {"enqueued": {controller: n}, "skipped": {controller: [svc]},
+        #  "partial": bool}
+        self.last_drift_report: dict = {}
 
     def run(
         self,
@@ -95,11 +124,32 @@ class Manager:
             klog.infof("Started %s", name)
 
         informer_factory.start(stop)
+        api_health.start_worker_watchdog(stop, self.heartbeats)
         if block:
             stop.wait()
             for thread in threads:
                 thread.join(timeout=5)
+            self._log_stragglers(threads)
         return threads
+
+    def _log_stragglers(self, threads: list[threading.Thread]) -> None:
+        """Name every controller thread that failed to join, plus the
+        reconcile key any of its workers is wedged on (heartbeat
+        table) — a silently leaked straggler made wedged shutdowns
+        undiagnosable."""
+        for thread in threads:
+            if not thread.is_alive():
+                continue
+            wedged = [
+                f"{worker} on {info['key']!r} for {info['age']:.0f}s"
+                for worker, info in self.heartbeats.snapshot().items()
+                if worker.startswith(thread.name)
+            ]
+            klog.errorf(
+                "controller thread %s failed to join within 5s%s",
+                thread.name,
+                f"; busy workers: {', '.join(wedged)}" if wedged else "",
+            )
 
     def drift_tick(self) -> int:
         """Drive ONE drift-resync round explicitly: walk every
@@ -108,12 +158,118 @@ class Manager:
         consumes, so an external tick can never diverge from a real
         one.  Returns the number of enqueued objects.  Used by the
         bench's drift-tick phase and the call-budget regression tier
-        to bracket exactly one round."""
+        to bracket exactly one round.
+
+        Degraded mode (health plane): a controller whose
+        ``DRIFT_SERVICES`` include an open circuit is skipped — its
+        verify reads would only feed the outage — and the tick is
+        marked partial in ``last_drift_report`` (exported into
+        bench_detail.json), so a stale verify round is visibly stale
+        rather than silently incomplete."""
+        report: dict = {"enqueued": {}, "skipped": {}, "partial": False}
         enqueued = 0
-        for controller in self.controllers.values():
+        for name, controller in self.controllers.items():
+            open_services = (
+                [
+                    service
+                    for service in getattr(controller, "DRIFT_SERVICES", ())
+                    if self._health.is_open(service)
+                ]
+                if self._health is not None
+                else []
+            )
+            if open_services:
+                report["skipped"][name] = open_services
+                report["partial"] = True
+                klog.warningf(
+                    "drift tick: skipping %s (open circuits: %s)",
+                    name, ", ".join(open_services),
+                )
+                continue
+            count = 0
             for lister, predicate, enqueue in controller.drift_resync_sources():
                 for obj in lister.list():
                     if predicate(obj):
                         enqueue(obj)
-                        enqueued += 1
+                        count += 1
+            report["enqueued"][name] = count
+            enqueued += count
+        self.last_drift_report = report
         return enqueued
+
+
+# ---------------------------------------------------------------------------
+# /healthz + /readyz (stdlib server, the webhook/server.py pattern)
+# ---------------------------------------------------------------------------
+
+
+class _HealthHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # probes arrive every few seconds from the kubelet: verbose level
+    # from day one (the webhook's healthz flooded logs at info)
+    def log_message(self, fmt, *args):
+        klog.v(4).infof("health http: " + fmt, *args)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._healthz()
+            return
+        if self.path == "/readyz":
+            self._readyz()
+            return
+        self.send_error(404)
+
+    def _healthz(self):
+        """Process liveness: 200 unless a worker is stuck past the
+        threshold (a wedged worker pool deserves a kubelet restart —
+        state is all external, restart-resume is proven by the
+        resilience tier)."""
+        klog.v(4).infof("healthz")
+        stuck = self.server.heartbeats.stuck(self.server.stuck_threshold)
+        body = {
+            "workers": self.server.heartbeats.snapshot(),
+            "stuck": [
+                {"worker": worker, "key": key, "age": round(age, 1)}
+                for worker, key, age in stuck
+            ],
+        }
+        self._respond(500 if stuck else 200, body)
+
+    def _readyz(self):
+        """Readiness: 503 while any API circuit is open — the pod is
+        alive but degraded, and deployment probes/rollouts should see
+        that without scraping logs."""
+        klog.v(4).infof("readyz")
+        tracker = self.server.health_tracker
+        open_services = tracker.open_services() if tracker is not None else []
+        body = {
+            "open_circuits": open_services,
+            "services": tracker.snapshot() if tracker is not None else {},
+        }
+        self._respond(503 if open_services else 200, body)
+
+    def _respond(self, code: int, body: dict):
+        payload = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+def make_health_server(
+    port: int,
+    health: Optional["api_health.HealthTracker"] = None,
+    heartbeats: Optional["api_health.WorkerHeartbeats"] = None,
+    stuck_threshold: float = WORKER_STUCK_THRESHOLD,
+    host: str = "",
+) -> ThreadingHTTPServer:
+    """Build the manager's health endpoint (bind port 0 in tests);
+    call ``serve_forever`` on a daemon thread to serve."""
+    server = ThreadingHTTPServer((host, port), _HealthHandler)
+    server.health_tracker = health
+    server.heartbeats = heartbeats or api_health.worker_heartbeats()
+    server.stuck_threshold = stuck_threshold
+    klog.infof("Health endpoint listening on :%d", server.server_address[1])
+    return server

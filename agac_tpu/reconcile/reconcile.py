@@ -28,6 +28,7 @@ import time
 from typing import Any, Callable
 
 from .. import klog
+from ..cloudprovider.aws import health as api_health
 from ..errors import NoRetryError, NotFoundError, is_no_retry
 from .result import Result
 from .workqueue import RateLimitingQueue
@@ -80,6 +81,7 @@ def process_next_work_item(
     process_delete: ProcessDeleteFunc,
     process_create_or_update: ProcessCreateOrUpdateFunc,
     on_sync_result: SyncResultFunc | None = None,
+    reconcile_deadline: float | None = None,
 ) -> bool:
     """Process one queue item; False only when the queue shut down.
 
@@ -92,10 +94,21 @@ def process_next_work_item(
     VERDICT r1 #6) lets controllers surface failing items to users,
     e.g. as Warning Events; it observes, never alters, the retry
     policy, and its own exceptions are contained.
+
+    Each item is bracketed by the API health plane's seams: the
+    worker-heartbeat table records (thread, key, since) for the
+    watchdog/``/healthz``, and ``reconcile_deadline`` (seconds, None/0
+    disables) arms the per-worker deadline the driver's poll loops and
+    the backend's retry backoffs consult — expiry surfaces as the
+    retryable DeadlineExceeded instead of a wedged worker.
     """
     item, shutdown = queue.get()
     if shutdown:
         return False
+    heartbeats = api_health.worker_heartbeats()
+    heartbeats.begin(item if isinstance(item, str) else repr(item))
+    if reconcile_deadline:
+        api_health.set_reconcile_deadline(reconcile_deadline)
     try:
         _reconcile_handler(
             item, queue, key_to_obj, process_delete, process_create_or_update,
@@ -104,6 +117,8 @@ def process_next_work_item(
     except Exception as err:  # containment: a bad item must not kill the worker
         klog.errorf("unhandled error reconciling %r: %s", item, err)
     finally:
+        api_health.clear_reconcile_deadline()
+        heartbeats.done()
         queue.done(item)
     return True
 

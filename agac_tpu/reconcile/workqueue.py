@@ -91,6 +91,21 @@ class BucketRateLimiter:
                 return 0.0
             return -self._tokens / self._qps
 
+    def qps(self) -> float:
+        with self._lock:
+            return self._qps
+
+    def set_qps(self, qps: float) -> None:
+        """Retune the refill rate in place — the seam the API health
+        plane's AIMD limiter adjusts (cloudprovider/aws/health.py).
+        Tokens accrued so far are settled at the OLD rate first, so a
+        rate cut takes effect from now rather than retroactively."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self._burst, self._tokens + (now - self._last) * self._qps)
+            self._last = now
+            self._qps = max(qps, 1e-9)
+
     def forget(self, item: Hashable) -> None:  # bucket has no per-item state
         pass
 

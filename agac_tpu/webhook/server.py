@@ -31,7 +31,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            klog.infof("healthz")
+            # kubelet probes hit this every few seconds — verbose
+            # level, or the probe traffic floods the logs
+            klog.v(4).infof("healthz")
             # Content-Length is mandatory under keep-alive: without it
             # the client waits forever for a body that never comes
             self._respond(200, b"ok", content_type="text/plain")

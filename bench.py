@@ -1059,6 +1059,12 @@ def run_drift_tick(n: int, workers: int) -> dict:
         "derived_tick_seconds_scaled": wall_bound,
         "derived_tick_seconds_real_quotas": round(wall_bound * LATENCY_SCALE, 1),
         "cache_stats": plane.stats(),
+        # degraded-mode marker (health plane): which controllers this
+        # tick enqueued vs skipped over open circuits, and whether the
+        # tick is therefore partial/stale — a healthy bench run reads
+        # partial=False; a brownout tick says so instead of silently
+        # under-reading (ISSUE 3)
+        "health": manager.last_drift_report,
         "note": (
             "counts measured over one isolated ticker round on a converged "
             "fleet (coalesced read plane at ~1 s tick scope so the round "

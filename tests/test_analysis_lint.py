@@ -352,6 +352,93 @@ class TestDriftReadOutsideReadPlane:
 
 
 # ---------------------------------------------------------------------------
+# unbounded-poll-loop
+# ---------------------------------------------------------------------------
+
+
+class TestUnboundedPollLoop:
+    def test_sleepy_poll_without_deadline_fires_once(self):
+        v = only(
+            run(
+                """
+                def wait_settled(self, arn):
+                    while True:
+                        status = self.ga.describe_accelerator(arn).status
+                        if status == "DEPLOYED":
+                            return
+                        self._sleep(self._poll_interval)
+                """,
+                path="agac_tpu/cloudprovider/aws/bad.py",
+            ),
+            "unbounded-poll-loop",
+        )
+        assert "deadline" in v.message
+
+    def test_deadline_consulting_loop_is_clean(self):
+        assert (
+            run(
+                """
+                def wait_settled(self, arn):
+                    deadline = monotonic() + self._poll_timeout
+                    while True:
+                        if self.ga.describe_accelerator(arn).status == "DEPLOYED":
+                            return
+                        if monotonic() >= deadline:
+                            raise TimeoutError(arn)
+                        self._sleep(self._poll_interval)
+                """,
+                path="agac_tpu/cloudprovider/aws/good.py",
+            )
+            == []
+        )
+
+    def test_health_plane_consulting_loop_is_clean(self):
+        assert (
+            run(
+                """
+                def wait_settled(self, arn):
+                    while True:
+                        if self.ga.describe_accelerator(arn).status == "DEPLOYED":
+                            return
+                        api_health.check_deadline("settle poll")
+                        self._sleep(self._poll_interval)
+                """,
+                path="agac_tpu/cloudprovider/aws/good.py",
+            )
+            == []
+        )
+
+    def test_sleepless_loop_is_clean(self):
+        # a tight computational loop is not a poll
+        assert (
+            run(
+                """
+                def drain(self, pages):
+                    while pages:
+                        pages.pop()
+                """,
+                path="agac_tpu/cloudprovider/aws/good.py",
+            )
+            == []
+        )
+
+    def test_rule_is_scoped_to_cloudprovider_and_controllers(self):
+        # the workqueue's delay waker sleeps by design under its own
+        # condition variable; the rule targets backend-facing polls
+        assert (
+            run(
+                """
+                def wait_settled(self, arn):
+                    while True:
+                        self._sleep(1.0)
+                """,
+                path="agac_tpu/reconcile/whatever.py",
+            )
+            == []
+        )
+
+
+# ---------------------------------------------------------------------------
 # the repo itself + CI wiring
 # ---------------------------------------------------------------------------
 
@@ -365,6 +452,7 @@ def test_rule_registry_ships_the_documented_rules():
         "reconcile-returns-result",
         "unguarded-optional-import",
         "drift-read-outside-read-plane",
+        "unbounded-poll-loop",
     }
 
 

@@ -108,6 +108,11 @@ def test_detail_artifact_written_and_complete(bench_run, detail_path):
     assert drift["aws_calls_total"] > 0
     assert drift["aws_calls_by_op"]
     assert "derived_tick_seconds_real_quotas" in drift
+    # degraded-mode marker (health plane): a healthy bench tick must
+    # be complete and say so — a partial tick would mean the call
+    # counts above silently under-read
+    assert drift["health"]["partial"] is False
+    assert drift["health"]["skipped"] == {}
     # baseline ran the same mixed workload
     assert detail["baseline"]["n_bindings"] >= 1
     assert detail["baseline"]["n_ingresses"] >= 1

@@ -23,90 +23,46 @@ and leave a duplicate accelerator).
 
 from __future__ import annotations
 
-import inspect
-import random
+import json
 import threading
+import time
+import urllib.request
 
 import pytest
 
 from agac_tpu import apis
 from agac_tpu.analysis import racecheck
-from agac_tpu.cloudprovider.aws.api import ELBv2API, GlobalAcceleratorAPI, Route53API
-from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+from agac_tpu.cloudprovider.aws import AWSDriver
 from agac_tpu.cloudprovider.aws.fake_backend import FakeAWSBackend
+from agac_tpu.cloudprovider.aws.health import (
+    GA_OPS,
+    ROUTE53_OPS,
+    HealthConfig,
+    HealthTracker,
+)
 from agac_tpu.cluster import FakeCluster
 from agac_tpu.controllers import (
     EndpointGroupBindingConfig,
     GlobalAcceleratorConfig,
     Route53Config,
 )
-from agac_tpu.manager import ControllerConfig
+from agac_tpu.manager import ControllerConfig, Manager, make_health_server
 
 from .fixtures import NLB_REGION, make_alb_ingress, make_lb_service
 from .test_resilience_e2e import start_manager, wait_until
 
-# Every method the driver can reach — exactly the three API interfaces,
-# so test helpers (add_load_balancer, records_in_zone, ...) stay fault-free.
-API_OPS = frozenset(
-    name
-    for cls in (GlobalAcceleratorAPI, ELBv2API, Route53API)
-    for name, member in vars(cls).items()
-    if inspect.isfunction(member) and not name.startswith("_")
-)
-MUTATING_PREFIXES = ("create_", "update_", "delete_", "add_", "remove_", "tag_", "change_")
 
-
-class ChaosAWS(FakeAWSBackend):
-    """FakeAWSBackend where any API call may raise a retryable error.
-
-    ``fault_budget`` bounds total injected faults; ``p`` is the
-    per-call fault probability; for mutating ops, ``ambiguous`` is the
-    conditional probability that the fault fires *after* the real call
-    committed (timeout-after-commit)."""
-
-    def __init__(self, seed: int, fault_budget: int, p: float = 0.25, ambiguous: float = 0.4):
-        super().__init__()
-        self._rng = random.Random(seed)
-        self._chaos_lock = threading.Lock()
-        self.fault_budget = fault_budget
-        self._p = p
-        self._ambiguous = ambiguous
-        self.faults_served = 0
-        # the test's own assertion predicates read through the same
-        # API — only controller threads get faults
-        self._exempt_thread = threading.current_thread()
-
-    def refill(self, budget: int) -> None:
-        with self._chaos_lock:
-            self.fault_budget = budget
-
-    def _roll(self, op: str) -> str:
-        """Returns 'ok', 'fail', or 'commit-then-fail'."""
-        if threading.current_thread() is self._exempt_thread:
-            return "ok"
-        with self._chaos_lock:
-            if self.fault_budget <= 0 or self._rng.random() >= self._p:
-                return "ok"
-            self.fault_budget -= 1
-            self.faults_served += 1
-            if op.startswith(MUTATING_PREFIXES) and self._rng.random() < self._ambiguous:
-                return "commit-then-fail"
-            return "fail"
-
-    def __getattribute__(self, name):
-        attr = super().__getattribute__(name)
-        if name in API_OPS:
-            def chaotic(*args, **kwargs):
-                fate = self._roll(name)
-                if fate == "fail":
-                    raise AWSAPIError("ThrottlingException", f"chaos: {name}")
-                result = attr(*args, **kwargs)
-                if fate == "commit-then-fail":
-                    raise AWSAPIError("RequestTimeout", f"chaos after commit: {name}")
-                return result
-
-            return chaotic
-        return attr
+def chaotic_backend(
+    seed: int, fault_budget: int, p: float = 0.25, ambiguous: float = 0.4
+) -> FakeAWSBackend:
+    """FakeAWSBackend with the first-class FaultPlan in chaos mode —
+    any API call may raise a retryable error while the seeded budget
+    lasts; mutating ops can fail *after* committing.  The test's own
+    thread is exempt (FaultPlan default), so assertion predicates read
+    clean truth through the same API."""
+    aws = FakeAWSBackend()
+    aws.install_fault_plan().chaos(seed, fault_budget, p=p, ambiguous=ambiguous)
+    return aws
 
 
 def nlb_hostname(i: int) -> str:
@@ -171,7 +127,7 @@ class TestChaosFleet:
     def test_fleet_converges_through_chaos_then_cleans_up(self):
         n_services, n_ingresses = 6, 2
         cluster = FakeCluster()
-        aws = ChaosAWS(seed=20260729, fault_budget=50)
+        aws = chaotic_backend(seed=20260729, fault_budget=50)
         for i in range(n_services):
             aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
         for i in range(n_ingresses):
@@ -225,10 +181,10 @@ class TestChaosFleet:
                 }
 
             assert wait_until(all_converged, timeout=30.0)
-            assert aws.faults_served > 0, "chaos never fired — test is vacuous"
+            assert aws.fault_plan.faults_served > 0, "chaos never fired — test is vacuous"
 
             # phase 2: tear half the fleet down under a fresh fault budget
-            aws.refill(30)
+            aws.fault_plan.refill(30)
             for i in (2, 3):
                 svc = cluster.get("Service", "default", f"svc{i}")
                 del svc.metadata.annotations[
@@ -285,7 +241,7 @@ class TestChaosFleet:
         from agac_tpu.errors import NotFoundError
 
         cluster = FakeCluster()
-        aws = ChaosAWS(seed=77, fault_budget=25)
+        aws = chaotic_backend(seed=77, fault_budget=25)
         aws.add_load_balancer("lb0", NLB_REGION, nlb_hostname(0))
         aws.add_load_balancer("bound", NLB_REGION, nlb_hostname(1).replace("lb1", "bound"))
 
@@ -331,10 +287,10 @@ class TestChaosFleet:
                 return weights.get(obj.status.endpoint_ids[0]) == 100
 
             assert wait_until(bound, timeout=30.0)
-            assert aws.faults_served > 0, "chaos never fired — test is vacuous"
+            assert aws.fault_plan.faults_served > 0, "chaos never fired — test is vacuous"
 
             # weight change propagates under a fresh fault budget
-            aws.refill(10)
+            aws.fault_plan.refill(10)
             obj = cluster.get("EndpointGroupBinding", "default", "binding")
             bound_id = obj.status.endpoint_ids[0]
             obj.spec.weight = 7
@@ -350,7 +306,7 @@ class TestChaosFleet:
             )
 
             # delete under chaos: endpoint unbound, finalizer cleared
-            aws.refill(10)
+            aws.fault_plan.refill(10)
             cluster.delete("EndpointGroupBinding", "default", "binding")
 
             def gone():
@@ -449,6 +405,130 @@ class TestChaosFleet:
         finally:
             for _, stop, _ in worlds.values():
                 stop.set()
+
+    def test_route53_brownout_bounded_calls_and_clean_recovery(self):
+        """The ISSUE 3 brownout drill: Route53 hard-down for a
+        sustained window over an N=50 fleet.
+
+        - GA/ELBv2 reconciles keep converging through the outage (the
+          brownout is one service, not the controller);
+        - once the route53 circuit opens, calls reaching the dead
+          service are bounded by the probe budget per half-open
+          interval — not O(workers x retries);
+        - ``/readyz`` reports the open circuit; a drift tick skips the
+          route53 controller and marks itself partial;
+        - after recovery the fleet reconverges with zero duplicate or
+          leaked AWS resources.
+        """
+        from agac_tpu.cloudprovider.aws.health import ELBV2_OPS
+
+        n, n_r53 = 50, 6
+        cluster = FakeCluster()
+        aws = FakeAWSBackend(quota_accelerators=2 * n)
+        plan = aws.install_fault_plan()
+        plan.outage(*ROUTE53_OPS, code="ServiceUnavailable")
+        zone = aws.add_hosted_zone("example.com")
+        tracker = HealthTracker(
+            HealthConfig(
+                window=5.0, min_calls=5, failure_ratio=0.5,
+                open_duration=0.5, probe_budget=1, aimd_qps=0,
+            )
+        )
+
+        def cloud_factory(region):
+            return AWSDriver(
+                tracker.guard(aws, "globalaccelerator", GA_OPS),
+                tracker.guard(aws, f"elbv2[{region}]", ELBV2_OPS),
+                tracker.guard(aws, "route53", ROUTE53_OPS),
+                poll_interval=0.01, poll_timeout=2.0,
+                lb_not_active_retry=0.05, accelerator_missing_retry=0.05,
+            )
+
+        for i in range(n):
+            aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+            annotations = {}
+            if i < n_r53:
+                annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = f"app{i}.example.com"
+            cluster.create(
+                "Service",
+                make_lb_service(
+                    name=f"svc{i}", hostname=nlb_hostname(i), annotations=annotations
+                ),
+            )
+
+        stop = threading.Event()
+        manager = Manager(resync_period=0.3, health=tracker)
+        manager.run(
+            cluster, fleet_config(workers=4), stop,
+            cloud_factory=cloud_factory, block=False,
+        )
+        server = make_health_server(0, health=tracker)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            # GA/ELB converge straight through the Route53 outage
+            assert wait_until(
+                lambda: len(aws.all_accelerator_arns()) == n, timeout=30.0
+            )
+            assert wait_until(lambda: tracker.is_open("route53"), timeout=10.0)
+
+            # sustained window: the dead service sees at most the
+            # probe budget per half-open interval, plus slack for
+            # probes already in flight at the boundaries
+            before = plan.faults_for(*ROUTE53_OPS)
+            window = 2.0
+            time.sleep(window)
+            leaked = plan.faults_for(*ROUTE53_OPS) - before
+            budget = window / 0.5 + 2  # intervals x probe_budget + slack
+            assert leaked <= budget, (
+                f"{leaked} calls reached the browned-out service in "
+                f"{window}s; probe budget allows ~{budget}"
+            )
+
+            # /readyz surfaces the degradation for deployment probes
+            url = f"http://127.0.0.1:{server.server_address[1]}/readyz"
+            try:
+                with urllib.request.urlopen(url, timeout=5) as response:
+                    raise AssertionError(f"readyz returned {response.status} while degraded")
+            except urllib.error.HTTPError as err:
+                assert err.code == 503
+                assert "route53" in json.loads(err.read())["open_circuits"]
+
+            # a drift tick in degraded mode skips the route53
+            # controller and says so
+            manager.drift_tick()
+            assert manager.last_drift_report["partial"] is True
+            assert "route53" in manager.last_drift_report["skipped"].get(
+                "route53-controller", []
+            )
+
+            # recovery: the service comes back, probes close the
+            # circuit, the fleet reconverges
+            plan.restore()
+            def records_converged():
+                names = {(r.name, r.type) for r in aws.records_in_zone(zone.id)}
+                return all(
+                    (f"app{i}.example.com.", rtype) in names
+                    for i in range(n_r53)
+                    for rtype in ("A", "TXT")
+                )
+            assert wait_until(records_converged, timeout=30.0)
+            assert wait_until(lambda: not tracker.is_open("route53"), timeout=10.0)
+
+            # zero duplicate or leaked AWS resources across the outage
+            assert len(aws.all_accelerator_arns()) == n
+            creates = [c for c in aws.calls if c[0] == "CreateAccelerator"]
+            assert len(creates) == n
+            owners = {
+                {t.key: t.value for t in aws.list_tags_for_resource(arn)}[
+                    "aws-global-accelerator-owner"
+                ]
+                for arn in aws.all_accelerator_arns()
+            }
+            assert len(owners) == n
+        finally:
+            stop.set()
+            server.shutdown()
+            server.server_close()
 
     def test_concurrent_workers_create_no_duplicates(self):
         """12 services, 4 workers, no faults: exactly one

@@ -1,0 +1,534 @@
+"""Unit tier for the API health plane (ISSUE 3,
+``agac_tpu/cloudprovider/aws/health.py``): circuit state transitions
+and AIMD limiter convergence on a fake clock, reconcile-deadline
+propagation (settle poll + in-client retry backoff), the guarded-API
+call budget an open circuit enforces (the tier-1 regression pin),
+worker heartbeats/watchdog, degraded drift ticks, and the manager's
+``/healthz`` + ``/readyz`` endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+from agac_tpu.cloudprovider.aws.fake_backend import FaultPlan
+from agac_tpu.cloudprovider.aws.health import (
+    OUTCOME_SUCCESS,
+    OUTCOME_THROTTLE,
+    ROUTE53_OPS,
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    AIMDLimiter,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    HealthConfig,
+    HealthTracker,
+    WorkerHeartbeats,
+    classify_error,
+    clear_reconcile_deadline,
+    deadline_remaining,
+    set_reconcile_deadline,
+    worker_heartbeats,
+)
+from agac_tpu.errors import is_no_retry
+from agac_tpu.manager import Manager, make_health_server
+from agac_tpu.reconcile import RateLimitingQueue, Result, process_next_work_item
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture(autouse=True)
+def _clean_deadline():
+    clear_reconcile_deadline()
+    yield
+    clear_reconcile_deadline()
+
+
+# ---------------------------------------------------------------------------
+# outcome classification
+# ---------------------------------------------------------------------------
+
+
+class TestClassification:
+    def test_throttle_5xx_connection_and_definite_answers(self):
+        assert classify_error(AWSAPIError("ThrottlingException")) == "throttle"
+        assert classify_error(AWSAPIError("ServiceUnavailable")) == "server-error"
+        assert classify_error(AWSAPIError("RequestError")) == "connection-error"
+        # a definite rejection is a HEALTHY service
+        assert classify_error(AWSAPIError("AcceleratorNotFoundException")) == "success"
+        assert classify_error(AWSAPIError("InvalidChangeBatch")) == "success"
+
+    def test_client_side_errors_are_neutral(self):
+        assert classify_error(DeadlineExceeded("x")) is None
+        assert classify_error(CircuitOpenError("route53", 1.0)) is None
+        assert classify_error(ValueError("bug")) is None
+
+    def test_deadline_and_circuit_errors_are_retryable(self):
+        # both must go through the normal requeue policy, never the
+        # NoRetry drop (the outage ends; the item must come back)
+        assert not is_no_retry(DeadlineExceeded("x"))
+        assert not is_no_retry(CircuitOpenError("route53", 1.0))
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state transitions (fake clock)
+# ---------------------------------------------------------------------------
+
+
+def make_breaker(clock, **kwargs):
+    defaults = dict(
+        window=10.0, min_calls=4, failure_ratio=0.5, open_duration=5.0,
+        probe_budget=2, clock=clock,
+    )
+    defaults.update(kwargs)
+    return CircuitBreaker(**defaults)
+
+
+class TestCircuitBreaker:
+    def test_stays_closed_below_min_calls(self):
+        clock = FakeClock()
+        breaker = make_breaker(clock)
+        for _ in range(3):
+            breaker.record(failed=True)
+        assert breaker.state() == STATE_CLOSED
+        assert breaker.allow() == (True, 0.0)
+
+    def test_opens_on_sustained_failure_ratio(self):
+        clock = FakeClock()
+        breaker = make_breaker(clock)
+        for _ in range(4):
+            breaker.record(failed=True)
+        assert breaker.state() == STATE_OPEN
+        allowed, retry_after = breaker.allow()
+        assert not allowed and retry_after > 0
+
+    def test_healthy_majority_never_trips(self):
+        clock = FakeClock()
+        breaker = make_breaker(clock)
+        for i in range(20):
+            breaker.record(failed=(i % 4 == 0))  # 25% < 50% ratio
+        assert breaker.state() == STATE_CLOSED
+
+    def test_window_forgets_old_failures(self):
+        clock = FakeClock()
+        breaker = make_breaker(clock)
+        for _ in range(3):
+            breaker.record(failed=True)
+        clock.advance(11.0)  # past the window
+        breaker.record(failed=True)
+        assert breaker.state() == STATE_CLOSED  # 1 failure in window
+
+    def test_half_open_probe_budget_then_close_on_success(self):
+        clock = FakeClock()
+        breaker = make_breaker(clock)
+        for _ in range(4):
+            breaker.record(failed=True)
+        clock.advance(5.0)
+        assert breaker.state() == STATE_HALF_OPEN
+        # exactly probe_budget probes per interval
+        assert breaker.allow()[0]
+        assert breaker.allow()[0]
+        assert not breaker.allow()[0]
+        breaker.record(failed=False)  # probe succeeded
+        assert breaker.state() == STATE_CLOSED
+        assert breaker.allow() == (True, 0.0)
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = FakeClock()
+        breaker = make_breaker(clock)
+        for _ in range(4):
+            breaker.record(failed=True)
+        clock.advance(5.0)
+        assert breaker.allow()[0]
+        breaker.record(failed=True)  # probe failed
+        assert breaker.state() == STATE_OPEN
+        assert not breaker.allow()[0]
+        # ... and the next interval admits probes again
+        clock.advance(5.0)
+        assert breaker.allow()[0]
+
+    def test_probe_budget_refills_per_interval(self):
+        clock = FakeClock()
+        breaker = make_breaker(clock, probe_budget=1)
+        for _ in range(4):
+            breaker.record(failed=True)
+        clock.advance(5.0)
+        assert breaker.allow()[0]
+        assert not breaker.allow()[0]
+        clock.advance(5.0)  # next half-open interval
+        assert breaker.allow()[0]
+
+
+# ---------------------------------------------------------------------------
+# AIMD limiter convergence (fake clock)
+# ---------------------------------------------------------------------------
+
+
+class TestAIMDLimiter:
+    def test_multiplicative_decrease_to_floor(self):
+        limiter = AIMDLimiter(qps=8.0, floor=1.0, decrease=0.5, clock=FakeClock())
+        rates = []
+        for _ in range(5):
+            limiter.on_throttle()
+            rates.append(limiter.rate())
+        assert rates == [4.0, 2.0, 1.0, 1.0, 1.0]  # halves, floors
+
+    def test_additive_recovery_to_ceiling(self):
+        limiter = AIMDLimiter(qps=8.0, floor=1.0, increase=1.0, decrease=0.5, clock=FakeClock())
+        for _ in range(3):
+            limiter.on_throttle()
+        assert limiter.rate() == 1.0
+        for _ in range(20):
+            limiter.on_success()
+        assert limiter.rate() == 8.0  # capped at the configured ceiling
+
+    def test_reserve_paces_at_the_cut_rate(self):
+        clock = FakeClock()
+        limiter = AIMDLimiter(qps=4.0, floor=1.0, burst=1, clock=clock)
+        assert limiter.reserve() == 0.0  # the burst token
+        assert limiter.reserve() == pytest.approx(0.25)  # 1/4 qps
+        for _ in range(2):
+            limiter.on_throttle()
+        # rate is now 1 qps: the next token is a full second out
+        # (minus the fractional refill at the old rate)
+        delay = limiter.reserve()
+        assert delay > 0.5
+
+    def test_service_health_feeds_the_limiter(self):
+        clock = FakeClock()
+        tracker = HealthTracker(
+            HealthConfig(window=100.0, min_calls=1000, aimd_qps=8.0, aimd_decrease=0.5),
+            clock=clock, sleep=lambda s: None,
+        )
+        health = tracker.service("route53")
+        health.record(OUTCOME_THROTTLE)
+        assert health.limiter.rate() == 4.0
+        health.record(OUTCOME_SUCCESS)
+        assert health.limiter.rate() > 4.0
+
+
+# ---------------------------------------------------------------------------
+# reconcile deadlines
+# ---------------------------------------------------------------------------
+
+
+class TestReconcileDeadline:
+    def test_set_remaining_clear(self):
+        clock = FakeClock()
+        set_reconcile_deadline(5.0, clock=clock)
+        assert deadline_remaining() == pytest.approx(5.0)
+        clock.advance(2.0)
+        assert deadline_remaining() == pytest.approx(3.0)
+        clear_reconcile_deadline()
+        assert deadline_remaining() is None
+
+    def test_settle_poll_cut_by_deadline(self):
+        """The acceptance-criteria wedge: an accelerator that never
+        settles holds the delete poll.  With poll_timeout far beyond
+        the reconcile deadline, the deadline cuts the poll with the
+        retryable DeadlineExceeded in ~deadline seconds, not
+        poll_timeout seconds."""
+        aws = FakeAWSBackend(settle_describes=10**9)  # never settles
+        driver = AWSDriver(aws, aws, aws, poll_interval=0.005, poll_timeout=180.0)
+        accelerator = aws.create_accelerator("wedge", "IPV4", True, [])
+        set_reconcile_deadline(0.1)
+        start = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            driver.cleanup_global_accelerator(accelerator.accelerator_arn)
+        assert time.monotonic() - start < 5.0
+
+    def test_backend_retry_backoff_checks_deadline(self):
+        """The in-client retry loop must not burn backoff sleeps the
+        caller can no longer use."""
+        from agac_tpu.cloudprovider.aws.real_backend import _SignedClient
+        from agac_tpu.cloudprovider.aws.sigv4 import Credentials
+
+        outcomes = []
+        client = _SignedClient(
+            "route53", "us-east-1", "https://example.invalid",
+            credentials=Credentials("AKID", "secret"),
+            transport=lambda *a: (503, b"<e><Code>ServiceUnavailable</Code></e>"),
+            attempts=3, sleep=lambda s: None,
+        )
+        client.on_outcome = outcomes.append
+        set_reconcile_deadline(1e-9)
+        with pytest.raises(DeadlineExceeded):
+            client.request("GET", "/", {}, b"")
+        # the first attempt ran and was classified before the retry
+        # consulted the deadline
+        assert outcomes == ["server-error"]
+
+    def test_worker_loop_arms_and_clears_the_deadline(self):
+        queue = RateLimitingQueue(name="deadline-test")
+        seen = {}
+
+        def handler(obj) -> Result:
+            seen["remaining"] = deadline_remaining()
+            seen["key"] = worker_heartbeats().current_key(
+                threading.current_thread().name
+            )
+            return Result()
+
+        queue.add("ns/obj")
+        assert process_next_work_item(
+            queue, lambda key: key, lambda key: Result(), handler,
+            reconcile_deadline=30.0,
+        )
+        assert 0 < seen["remaining"] <= 30.0
+        assert seen["key"] == "ns/obj"
+        # both are cleaned up after the item
+        assert deadline_remaining() is None
+        assert worker_heartbeats().current_key(
+            threading.current_thread().name
+        ) is None
+        queue.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# guarded API: the open-circuit call budget (tier-1 regression pin)
+# ---------------------------------------------------------------------------
+
+
+class TestOpenCircuitCallBudget:
+    def test_open_circuit_bounds_calls_to_probe_budget(self):
+        """Sustained outage: once the circuit opens, calls that reach
+        the dead service are bounded by the probe budget per half-open
+        interval — NOT O(attempts) like the fixed-rate retry storm the
+        plane replaces."""
+        clock = FakeClock()
+        aws = FakeAWSBackend()
+        plan = aws.install_fault_plan(FaultPlan(exempt_creator=False))
+        plan.outage("list_hosted_zones", code="ServiceUnavailable")
+        config = HealthConfig(
+            window=10.0, min_calls=5, failure_ratio=0.5,
+            open_duration=1.0, probe_budget=1, aimd_qps=0,
+        )
+        tracker = HealthTracker(config, clock=clock, sleep=lambda s: None)
+        guarded = tracker.guard(aws, "route53", ROUTE53_OPS)
+
+        attempts = 200
+        rejected = 0
+        for _ in range(attempts):
+            try:
+                guarded.list_hosted_zones(100, None)
+            except CircuitOpenError:
+                rejected += 1
+            except AWSAPIError:
+                pass
+            clock.advance(0.05)
+        elapsed = attempts * 0.05  # 10 s of outage
+        intervals = elapsed / config.open_duration
+        # opening takes min_calls failures; each half-open interval
+        # admits at most probe_budget probes
+        budget = config.min_calls + config.probe_budget * (intervals + 1)
+        assert plan.faults_served <= budget, (
+            f"{plan.faults_served} calls reached the dead service; "
+            f"budget is {budget}"
+        )
+        # and the breaker actually shed the rest
+        assert rejected >= attempts - budget - 1
+        assert tracker.is_open("route53")
+        assert tracker.open_services() == ["route53"]
+
+    def test_recovery_closes_the_circuit_and_calls_flow_again(self):
+        clock = FakeClock()
+        aws = FakeAWSBackend()
+        aws.add_hosted_zone("example.com")
+        plan = aws.install_fault_plan(FaultPlan(exempt_creator=False))
+        plan.outage("list_hosted_zones")
+        tracker = HealthTracker(
+            HealthConfig(window=10.0, min_calls=3, open_duration=1.0, aimd_qps=0),
+            clock=clock, sleep=lambda s: None,
+        )
+        guarded = tracker.guard(aws, "route53", ROUTE53_OPS)
+        for _ in range(3):
+            with pytest.raises(AWSAPIError):
+                guarded.list_hosted_zones(100, None)
+        assert tracker.is_open("route53")
+        plan.restore()
+        clock.advance(1.1)  # half-open: the probe goes through
+        zones, _ = guarded.list_hosted_zones(100, None)
+        assert len(zones) == 1
+        assert not tracker.is_open("route53")
+
+    def test_non_api_attributes_pass_through(self):
+        tracker = HealthTracker(sleep=lambda s: None)
+        aws = FakeAWSBackend()
+        guarded = tracker.guard(aws, "route53", ROUTE53_OPS)
+        zone = guarded.add_hosted_zone("example.com")  # test helper, unguarded
+        assert zone.name == "example.com."
+        assert guarded.calls == []
+
+
+# ---------------------------------------------------------------------------
+# hang-until-deadline fault + heartbeats/watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestHangAndHeartbeats:
+    def test_hang_until_deadline_surfaces_timeout(self):
+        aws = FakeAWSBackend()
+        plan = aws.install_fault_plan(FaultPlan(exempt_creator=False))
+        plan.hang_until_deadline("describe_accelerator")
+        set_reconcile_deadline(0.05)
+        start = time.monotonic()
+        with pytest.raises(AWSAPIError) as err:
+            aws.describe_accelerator("arn:whatever")
+        assert err.value.code == "RequestTimeout"
+        assert 0.04 <= time.monotonic() - start < 5.0
+
+    def test_heartbeats_track_and_report_stuck_workers(self):
+        clock = FakeClock()
+        heartbeats = WorkerHeartbeats(clock=clock)
+        heartbeats.begin("default/web")
+        me = threading.current_thread().name
+        assert heartbeats.current_key(me) == "default/web"
+        assert heartbeats.stuck(threshold=300.0) == []
+        clock.advance(301.0)
+        stuck = heartbeats.stuck(threshold=300.0)
+        assert [(thread, key) for thread, key, _ in stuck] == [(me, "default/web")]
+        heartbeats.done()
+        assert heartbeats.stuck(threshold=0.0) == []
+
+
+# ---------------------------------------------------------------------------
+# degraded drift ticks
+# ---------------------------------------------------------------------------
+
+
+class _FakeLister:
+    def __init__(self, objs):
+        self._objs = objs
+
+    def list(self):
+        return list(self._objs)
+
+
+class _FakeController:
+    DRIFT_SERVICES = ("route53",)
+
+    def __init__(self):
+        self.enqueued = []
+
+    def drift_resync_sources(self):
+        return [(_FakeLister(["a", "b"]), lambda o: True, self.enqueued.append)]
+
+
+class TestDegradedDriftTick:
+    def _tracker_with_open_route53(self, clock):
+        tracker = HealthTracker(
+            HealthConfig(window=10.0, min_calls=2, open_duration=60.0, aimd_qps=0),
+            clock=clock, sleep=lambda s: None,
+        )
+        health = tracker.service("route53")
+        health.record("server-error")
+        health.record("server-error")
+        assert tracker.is_open("route53")
+        return tracker
+
+    def test_open_circuit_skips_controller_and_marks_partial(self):
+        clock = FakeClock()
+        tracker = self._tracker_with_open_route53(clock)
+        manager = Manager(health=tracker)
+        r53 = _FakeController()
+        ga = _FakeController()
+        ga.DRIFT_SERVICES = ("globalaccelerator",)
+        manager.controllers = {"route53-controller": r53, "ga-controller": ga}
+        assert manager.drift_tick() == 2  # only the GA controller ticks
+        assert r53.enqueued == []
+        assert ga.enqueued == ["a", "b"]
+        assert manager.last_drift_report == {
+            "enqueued": {"ga-controller": 2},
+            "skipped": {"route53-controller": ["route53"]},
+            "partial": True,
+        }
+
+    def test_healthy_tick_is_complete(self):
+        manager = Manager(health=HealthTracker(sleep=lambda s: None))
+        controller = _FakeController()
+        manager.controllers = {"route53-controller": controller}
+        assert manager.drift_tick() == 2
+        assert manager.last_drift_report["partial"] is False
+        assert manager.last_drift_report["skipped"] == {}
+
+
+# ---------------------------------------------------------------------------
+# /healthz + /readyz
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as response:
+            return response.status, json.loads(response.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+class TestHealthServer:
+    @pytest.fixture
+    def served(self):
+        clock = FakeClock()
+        tracker = HealthTracker(
+            HealthConfig(window=10.0, min_calls=2, open_duration=60.0, aimd_qps=0),
+            sleep=lambda s: None,
+        )
+        heartbeats = WorkerHeartbeats(clock=clock)
+        server = make_health_server(
+            0, health=tracker, heartbeats=heartbeats, stuck_threshold=300.0
+        )
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            yield base, tracker, heartbeats, clock
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_ready_and_live_when_healthy(self, served):
+        base, _, _, _ = served
+        status, body = _get(base + "/healthz")
+        assert status == 200 and body["stuck"] == []
+        status, body = _get(base + "/readyz")
+        assert status == 200 and body["open_circuits"] == []
+
+    def test_readyz_reports_open_circuit(self, served):
+        base, tracker, _, _ = served
+        health = tracker.service("route53")
+        health.record("connection-error")
+        health.record("connection-error")
+        status, body = _get(base + "/readyz")
+        assert status == 503
+        assert body["open_circuits"] == ["route53"]
+        assert body["services"]["route53"]["circuit"]["state"] == "open"
+
+    def test_healthz_reports_stuck_worker(self, served):
+        base, _, heartbeats, clock = served
+        heartbeats.begin("default/wedged")
+        try:
+            clock.advance(301.0)
+            status, body = _get(base + "/healthz")
+            assert status == 500
+            assert body["stuck"][0]["key"] == "default/wedged"
+        finally:
+            heartbeats.done()

@@ -24,7 +24,6 @@ from agac_tpu.cloudprovider.aws.driver import (
     OWNER_TAG_KEY,
     TARGET_HOSTNAME_TAG_KEY,
 )
-from agac_tpu.cloudprovider.aws.errors import AWSAPIError
 from agac_tpu.cloudprovider.aws.types import Tag
 from agac_tpu.cluster import FakeCluster
 from agac_tpu.manager import ControllerConfig, Manager
@@ -217,28 +216,13 @@ class TestCleanShutdown:
             ), [t.name for t in threading_mod.enumerate()]
 
 
-class ThrottlingAWS(FakeAWSBackend):
+def throttling_backend(op_name: str, failures: int) -> FakeAWSBackend:
     """Fails the first N calls of one operation with a retryable API
-    error — the ThrottlingException shape."""
-
-    def __init__(self, op_name: str, failures: int):
-        super().__init__()
-        self._op = op_name
-        self._remaining = failures
-        self.faults_served = 0
-
-    def __getattribute__(self, name):
-        attr = super().__getattribute__(name)
-        if name == object.__getattribute__(self, "_op"):
-            def maybe_fail(*args, **kwargs):
-                if self._remaining > 0:
-                    self._remaining -= 1
-                    self.faults_served += 1
-                    raise AWSAPIError("ThrottlingException", "Rate exceeded")
-                return attr(*args, **kwargs)
-
-            return maybe_fail
-        return attr
+    error — the ThrottlingException shape, scripted through the
+    first-class FaultPlan (``throttle-N-times``)."""
+    aws = FakeAWSBackend()
+    aws.install_fault_plan().throttle(op_name, times=failures)
+    return aws
 
 
 class TestFaultInjection:
@@ -246,7 +230,7 @@ class TestFaultInjection:
         """Mid-chain failure triggers rollback (no orphaned
         accelerator) and rate-limited retry eventually converges."""
         cluster, _ = world
-        aws = ThrottlingAWS("create_listener", failures=2)
+        aws = throttling_backend("create_listener", failures=2)
         aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
         stop = start_manager(cluster, aws)
         try:
@@ -254,18 +238,18 @@ class TestFaultInjection:
             assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
             arn = aws.all_accelerator_arns()[0]
             assert wait_until(lambda: len(aws.list_listeners(arn, 100, None)[0]) == 1)
-            assert aws.faults_served == 2
+            assert aws.fault_plan.faults_served == 2
         finally:
             stop.set()
 
     def test_describe_lb_outage_retries_until_healthy(self, world):
         cluster, _ = world
-        aws = ThrottlingAWS("describe_load_balancers", failures=3)
+        aws = throttling_backend("describe_load_balancers", failures=3)
         aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
         stop = start_manager(cluster, aws)
         try:
             cluster.create("Service", make_lb_service())
             assert wait_until(lambda: len(aws.all_accelerator_arns()) == 1)
-            assert aws.faults_served == 3
+            assert aws.fault_plan.faults_served == 3
         finally:
             stop.set()
