@@ -34,6 +34,13 @@ stack — the classes ruff's pyflakes-tier cannot express:
   the health plane (ISSUE 3): an unbounded poll against a wedged
   backend holds its worker forever with no signal — exactly the
   180 s-settle-poll wedge the reconcile deadline exists to cut.
+- ``delete-without-ownership-check`` — teardown calls reachable from
+  the GC sweeper (``controllers/garbagecollector.py``) must flow
+  through an ownership-verification helper (ISSUE 4): the sweeper is
+  the only controller that deletes resources NOBODY asked it to touch,
+  so a deletion decided on stale/cached claims alone would be the
+  worst bug this codebase can ship — destroying a live cluster's
+  resources with no event trail.
 
 Suppression: append ``# agac-lint: ignore[rule-id] -- justification``
 to the offending line.  The justification is mandatory.
@@ -380,6 +387,10 @@ def check_unguarded_optional_import(
 # - `describe_endpoint_group`: the EndpointGroupBinding verify read —
 #   one call per binding per tick, keyed by an arn the topology cache
 #   cannot resolve, and GA offers no batch variant.
+# - `verify_accelerator_orphan`: the GC sweeper's pre-deletion
+#   ownership verify (ISSUE 4) — one live tag read per confirmed
+#   orphan, deliberately OUTSIDE the caches: a deletion decision must
+#   never rest on a cached ownership claim.
 #
 # Anything else in driver.py touching a raw list_*/describe_* op is a
 # coalescing regression and must either go through the read plane or
@@ -392,6 +403,7 @@ _READ_PLANE_FUNCS = frozenset(
         "_list_all_hosted_zones", "_walk_hosted_zone",
         "_list_related", "_delete_accelerator",
         "update_endpoint_weight", "describe_endpoint_group",
+        "verify_accelerator_orphan",
     }
 )
 
@@ -500,6 +512,73 @@ def check_unbounded_poll_loop(tree: ast.Module, ctx: LintContext) -> Iterator[Vi
             "plane — a wedged backend holds this worker forever; check "
             "`api_health.check_deadline(...)` (or a local deadline) each turn",
         )
+
+
+# ---------------------------------------------------------------------------
+# delete-without-ownership-check
+# ---------------------------------------------------------------------------
+
+# the teardown operations the GC sweeper can reach: the drivers'
+# cleanup orchestrations plus the raw service deletes and the
+# record-change op (a DELETE change batch)
+_GC_DELETE_OPS = frozenset(
+    {
+        "cleanup_global_accelerator", "cleanup_record_set",
+        "delete_accelerator", "delete_listener", "delete_endpoint_group",
+        "change_resource_record_sets",
+    }
+)
+
+# what counts as an ownership-verification helper: a call (or the
+# containing function itself) named like the GC module's verify
+# funnels — verify_accelerator_orphan_ownership,
+# verify_record_orphan_ownership, verify_accelerator_orphan, ...
+_OWNERSHIP_VERIFYISH = re.compile(r"verify_\w*(ownership|orphan)", re.IGNORECASE)
+
+
+def _is_gc_module(ctx: LintContext) -> bool:
+    return "controllers" in ctx.path.parts and ctx.path.name == "garbagecollector.py"
+
+
+@rule(
+    "delete-without-ownership-check",
+    "teardown calls in the GC sweeper must flow through an "
+    "ownership-verification helper — the sweeper deletes on its own "
+    "initiative, so unverified deletion is the worst shippable bug",
+)
+def check_delete_without_ownership_check(
+    tree: ast.Module, ctx: LintContext
+) -> Iterator[Violation]:
+    if not _is_gc_module(ctx):
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _OWNERSHIP_VERIFYISH.search(fn.name):
+            continue  # the verify helper itself is the sanctioned site
+        verifies = any(
+            isinstance(node, ast.Call)
+            and (name := _call_target_name(node)) is not None
+            and _OWNERSHIP_VERIFYISH.search(name)
+            for node in ast.walk(fn)
+        )
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_target_name(node)
+            if name not in _GC_DELETE_OPS:
+                continue
+            if verifies:
+                continue
+            yield Violation(
+                "delete-without-ownership-check",
+                str(ctx.path),
+                node.lineno,
+                f"{name}() reachable from the GC sweeper without an "
+                "ownership-verification helper in the same function — "
+                "route the deletion through "
+                "verify_*_orphan_ownership(...) first",
+            )
 
 
 # ---------------------------------------------------------------------------

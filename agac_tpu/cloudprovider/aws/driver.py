@@ -247,6 +247,24 @@ def Route53OwnerValue(cluster_name: str, resource: str, ns: str, name: str) -> s
     )
 
 
+def parse_route53_owner_value(
+    value: str, cluster_name: str
+) -> Optional[tuple[str, str, str]]:
+    """Inverse of ``Route53OwnerValue`` for THIS cluster: a TXT value
+    matching the heritage format yields ``(resource, ns, name)``;
+    anything else — other clusters' values, other tools' TXT content,
+    malformed identities — yields None.  The GC sweeper enumerates
+    record ownership through this, so parsing is strict on purpose: an
+    unparseable value can never become a deletion candidate."""
+    prefix = f'"heritage=aws-global-accelerator-controller,cluster={cluster_name},'
+    if not (value.startswith(prefix) and value.endswith('"')):
+        return None
+    parts = value[len(prefix):-1].split("/")
+    if len(parts) != 3 or not all(parts):
+        return None
+    return parts[0], parts[1], parts[2]
+
+
 def replace_wildcards(s: str) -> str:
     """Route53 stores ``*`` as ``\\052`` (reference ``route53.go:369-371``)."""
     return s.replace("\\052", "*", 1)
@@ -442,6 +460,68 @@ class AWSDriver:
                 OWNER_TAG_KEY: accelerator_owner_tag_value(resource, ns, name),
                 CLUSTER_TAG_KEY: cluster_name,
             }
+        )
+
+    # ------------------------------------------------------------------
+    # Global Accelerator: orphan GC support (ISSUE 4)
+    # ------------------------------------------------------------------
+    def list_cluster_owned_pairs(
+        self, cluster_name: str
+    ) -> list[tuple[Accelerator, list[Tag]]]:
+        """Every (accelerator, tags) pair this cluster's controller
+        owns — the GC sweeper's candidate enumeration.  Reads the
+        shared discovery snapshot (one tag scan per TTL window), never
+        per-object live reads: the sweep's scale cost is the same one
+        the reconcile path already pays."""
+        return self._pairs_by_tags(
+            {MANAGED_TAG_KEY: "true", CLUSTER_TAG_KEY: cluster_name}
+        )
+
+    def list_owned_record_owners(self, cluster_name: str) -> set[tuple[str, str, str]]:
+        """The ``(resource, ns, name)`` identities holding Route53
+        ownership TXT records for this cluster, across every hosted
+        zone — the GC sweeper's record-orphan enumeration.  Zone and
+        record reads go through the coalesced read plane (zone snapshot
+        + per-zone record-set cache), so a sweep shares the same
+        snapshots a drift tick uses."""
+        if self._zone_cache is not None:
+            zones = self._zone_cache.zones(self._list_all_hosted_zones)
+        else:
+            zones = self._list_all_hosted_zones()
+        owners: set[tuple[str, str, str]] = set()
+        for zone in zones:
+            for record_set in self._list_record_sets(zone.id):
+                for record in record_set.resource_records:
+                    owner = parse_route53_owner_value(record.value, cluster_name)
+                    if owner is not None:
+                        owners.add(owner)
+        return owners
+
+    def verify_accelerator_orphan(
+        self, arn: str, cluster_name: str, owner_value: str
+    ) -> bool:
+        """The live pre-deletion ownership verify the GC's teardown
+        funnel MUST pass through (lint rule
+        ``delete-without-ownership-check``): re-reads the accelerator's
+        tags from AWS — deliberately NOT from the discovery snapshot,
+        because a deletion decision must never rest on a cached claim —
+        and confirms it still carries this cluster's managed/owner
+        tags.  Returns False when the accelerator is already gone or
+        the tags no longer match (someone re-tagged or adopted it):
+        both mean "do not delete"."""
+        try:
+            tags = self.ga.list_tags_for_resource(arn)
+        except AWSAPIError as err:
+            if err.code == ERR_ACCELERATOR_NOT_FOUND:
+                return False  # already gone — nothing to tear down
+            raise
+        return tags_contains_all_values(
+            tags,
+            {
+                MANAGED_TAG_KEY: "true",
+                CLUSTER_TAG_KEY: cluster_name,
+                OWNER_TAG_KEY: owner_value,
+            },
         )
 
     # ------------------------------------------------------------------

@@ -11,7 +11,13 @@ from the environment so annotated Services find their load balancers:
 
 - ``AGAC_FAKE_LBS``: comma-separated ``name=hostname`` pairs (region
   is parsed from the hostname);
-- ``AGAC_FAKE_ZONES``: comma-separated hosted-zone names.
+- ``AGAC_FAKE_ZONES``: comma-separated hosted-zone names;
+- ``AGAC_FAKE_STATE``: path to a JSON state file that makes the fake
+  DURABLE across process generations (``FileBackedFakeAWSBackend``) —
+  the kill-recovery drills' ground truth;
+- ``AGAC_FAKE_CRASH``: ``op:when[,op:when...]`` one-shot crash faults
+  mapped to hard process death (``os._exit(137)``) at the exact API
+  boundary — the in-repo ``kill -9`` (see ``FaultPlan.crash``).
 
 The default mode builds the real SigV4 HTTP backend.
 
@@ -38,7 +44,7 @@ from .cache import (
     RecordSetCache,
 )
 from .driver import AWSDriver
-from .fake_backend import FakeAWSBackend
+from .fake_backend import FakeAWSBackend, FaultPlan, FileBackedFakeAWSBackend
 from .health import ELBV2_OPS, GA_OPS, ROUTE53_OPS, HealthConfig, HealthTracker
 from .load_balancer import get_lb_name_from_hostname
 
@@ -287,12 +293,44 @@ def _seed_from_environment(backend: FakeAWSBackend) -> None:
         backend.add_hosted_zone(zone)
 
 
+def _install_crash_plan(backend: FakeAWSBackend) -> None:
+    """``AGAC_FAKE_CRASH=op:when[,op:when...]`` arms one-shot crash
+    faults (``FaultPlan.crash``) on the shared fake backend, mapped to
+    hard process death — the ``kill -9`` analog the kill-recovery
+    drills in ``tests/test_process_e2e.py`` drive.  ``when`` is
+    ``before`` (default) or ``after-commit``."""
+    raw = os.environ.get("AGAC_FAKE_CRASH", "")
+    if not raw:
+        return
+    from ... import klog
+
+    plan = backend.install_fault_plan(FaultPlan(exempt_creator=False))
+    for entry in filter(None, raw.split(",")):
+        op, _, when = entry.partition(":")
+        plan.crash(op.strip(), when=when.strip() or "before")
+
+    def die(crash):
+        klog.errorf("AGAC_FAKE_CRASH: %s — exiting hard", crash)
+        os._exit(137)  # the kill -9 exit status, uncatchable like it
+
+    plan.on_crash = die
+
+
 def shared_fake_backend() -> FakeAWSBackend:
     global _fake_backend
     with _lock:
         if _fake_backend is None:
-            _fake_backend = FakeAWSBackend()
+            # AGAC_FAKE_STATE makes the fake AWS durable (a JSON state
+            # file shared across process generations) — committed
+            # mutations survive a kill -9, which is what makes crash
+            # drills against AGAC_CLOUD=fake meaningful
+            state_path = os.environ.get("AGAC_FAKE_STATE", "")
+            if state_path:
+                _fake_backend = FileBackedFakeAWSBackend(state_path)
+            else:
+                _fake_backend = FakeAWSBackend()
             _seed_from_environment(_fake_backend)
+            _install_crash_plan(_fake_backend)
         return _fake_backend
 
 
@@ -332,6 +370,26 @@ def _guarded_handles(ga, elbv2, route53, region: str):
     )
 
 
+def _driver_timing() -> dict:
+    """Driver pacing knobs, env-overridable: production keeps the
+    reference's constants (10 s settle poll / 180 s budget, 30 s
+    LB-not-active requeue, 60 s accelerator-missing requeue); the
+    fake-backed drills and demos shrink them so convergence is
+    observable in seconds."""
+    from .driver import ACCELERATOR_MISSING_RETRY, LB_NOT_ACTIVE_RETRY
+
+    return dict(
+        poll_interval=_env_float("AGAC_POLL_INTERVAL", 10.0),
+        poll_timeout=_env_float("AGAC_POLL_TIMEOUT", 180.0),
+        lb_not_active_retry=_env_float(
+            "AGAC_LB_NOT_ACTIVE_RETRY", LB_NOT_ACTIVE_RETRY
+        ),
+        accelerator_missing_retry=_env_float(
+            "AGAC_ACCELERATOR_MISSING_RETRY", ACCELERATOR_MISSING_RETRY
+        ),
+    )
+
+
 def real_cloud_factory(region: str) -> AWSDriver:
     caches = dict(
         discovery_cache=_shared_discovery_cache(),
@@ -339,6 +397,7 @@ def real_cloud_factory(region: str) -> AWSDriver:
         topology_cache=_shared_topology_cache(),
         record_cache=_shared_record_cache(),
         lb_coalescer=_shared_lb_coalescer(region),
+        **_driver_timing(),
     )
     if os.environ.get("AGAC_CLOUD") == "fake":
         backend = shared_fake_backend()

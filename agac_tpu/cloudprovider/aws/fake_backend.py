@@ -35,14 +35,15 @@ reproduced because the drivers depend on them:
 from __future__ import annotations
 
 import inspect
-import itertools
+import json
+import os
 import random
 import re
 import threading
 import uuid
 from collections import deque
 from dataclasses import replace
-from typing import Optional
+from typing import Callable, Optional
 
 from ...analysis import racecheck
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
@@ -74,6 +75,7 @@ from .types import (
     HostedZone,
     Listener,
     LoadBalancer,
+    PortRange,
     ResourceRecordSet,
     Tag,
 )
@@ -165,6 +167,41 @@ _MUTATING_PREFIXES = (
 )
 
 
+class SimulatedCrash(BaseException):
+    """The process/worker died at this exact API-call boundary.
+
+    Raised by ``FaultPlan.crash`` schedules.  A ``BaseException`` on
+    purpose: the retry/requeue machinery catches ``Exception`` — a
+    crash must never be absorbed into a backoff retry, because the
+    whole point is that NOTHING after the death point runs.  The drill
+    harness maps it to real death: in-process drills let it kill the
+    worker thread; the subprocess drills (``AGAC_FAKE_CRASH``,
+    factory.py) map it to ``os._exit`` — the ``kill -9`` analog."""
+
+    def __init__(self, op: str, when: str):
+        self.op = op
+        self.when = when
+        super().__init__(f"simulated crash {when} {op}")
+
+
+class _SerialCounter:
+    """``itertools.count`` with a readable current value, so durable
+    backends can persist it and resume without ID collisions."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, start: int = 1):
+        self.value = start
+
+    def __next__(self) -> int:
+        value = self.value
+        self.value += 1
+        return value
+
+    def __iter__(self) -> "_SerialCounter":
+        return self
+
+
 class _Fault:
     """One scripted fault: ``kind`` is fail / commit-then-fail / hang;
     ``remaining`` counts down to exhaustion."""
@@ -188,7 +225,11 @@ class FaultPlan:
        ambiguous-timeout shape: the change commits, the caller sees an
        error), ``hang_until_deadline(op)`` (the call blocks until the
        calling worker's reconcile deadline expires, then surfaces a
-       timeout — the wedge shape the deadline machinery exists to cut);
+       timeout — the wedge shape the deadline machinery exists to cut),
+       and ``crash(op, when="before"|"after-commit")`` (the caller DIES
+       at the op boundary — a ``SimulatedCrash`` the kill-recovery
+       drills map to worker/process death; ``after-commit`` commits the
+       mutation first, the torn-write shape of a kill -9 mid-chain);
     2. **outages**: ``outage(*ops)`` fails every call until
        ``restore()`` — the sustained-brownout shape the circuit
        breaker reacts to;
@@ -217,6 +258,10 @@ class FaultPlan:
         # safety valve for hang_until_deadline when no deadline is
         # armed: never block a call longer than this
         self.max_hang = 30.0
+        # how a SimulatedCrash becomes death: None raises it (kills
+        # the worker thread in in-process drills); the subprocess
+        # drills set os._exit here — the kill -9 analog
+        self.on_crash: Optional[Callable[[SimulatedCrash], None]] = None
 
     # -- scripted schedules -------------------------------------------------
     def _script(self, op: str, kind: str, code: str, times: int) -> "FaultPlan":
@@ -237,6 +282,19 @@ class FaultPlan:
 
     def hang_until_deadline(self, op: str, times: int = 1) -> "FaultPlan":
         return self._script(op, "hang", "RequestTimeout", times)
+
+    def crash(self, op: str, when: str = "before", times: int = 1) -> "FaultPlan":
+        """Kill the caller at this op boundary: ``when="before"`` dies
+        without committing (the op never ran), ``when="after-commit"``
+        commits the change first (a durable backend has already flushed
+        it) and THEN dies — the torn-write shape a ``kill -9``
+        mid-mutation leaves behind.  The death is a ``SimulatedCrash``
+        (a BaseException, so no retry path can absorb it); set
+        ``on_crash`` to map it to real process death (the subprocess
+        drills use ``os._exit``)."""
+        if when not in ("before", "after-commit"):
+            raise ValueError(f"crash when= must be 'before' or 'after-commit', got {when!r}")
+        return self._script(op, f"crash-{when}", "SimulatedCrash", times)
 
     # -- sustained outage ---------------------------------------------------
     def outage(self, *ops: str, code: str = "ServiceUnavailable") -> "FaultPlan":
@@ -330,6 +388,12 @@ class FaultPlan:
             threading.Event().wait(wait)
         raise AWSAPIError("RequestTimeout", f"fault plan: {op} hung past deadline")
 
+    def _die(self, crash: SimulatedCrash) -> None:
+        hook = self.on_crash
+        if hook is not None:
+            hook(crash)
+        raise crash
+
     def wrap(self, op: str, call):
         def faulted(*args, **kwargs):
             fate = self._decide(op)
@@ -340,8 +404,12 @@ class FaultPlan:
                 self._hang(op)
             if kind == "fail":
                 raise AWSAPIError(code, f"fault plan: {op}")
-            result = call(*args, **kwargs)  # commit-then-fail
+            if kind == "crash-before":
+                self._die(SimulatedCrash(op, "before"))
+            result = call(*args, **kwargs)  # commit-then-fail / crash-after-commit
             del result
+            if kind == "crash-after-commit":
+                self._die(SimulatedCrash(op, "after-commit"))
             raise AWSAPIError(code, f"fault plan (after commit): {op}")
 
         return faulted
@@ -402,11 +470,17 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         self._load_balancers: dict[str, LoadBalancer] = guard("_load_balancers")  # name -> LB
         self._zones: dict[str, HostedZone] = guard("_zones")  # id -> zone
         self._records: dict[str, dict[tuple[str, str], ResourceRecordSet]] = guard("_records")
-        self._counter = itertools.count(1)
+        self._counter = _SerialCounter()
         # call log for assertions ("CreateAccelerator", arn), ...
         self.calls: list[tuple] = []
         # first-class fault injection (see FaultPlan); None = clean
         self.fault_plan: Optional[FaultPlan] = None
+        # durability seam (see FileBackedFakeAWSBackend): wraps every
+        # API op INSIDE the fault plan, so a commit is flushed to disk
+        # before a commit-then-fail error or an after-commit crash
+        # surfaces — exactly the ordering a real backend gives a dying
+        # client
+        self._persist_hook: Optional[Callable] = None
 
     def install_fault_plan(self, plan: Optional[FaultPlan] = None) -> FaultPlan:
         """Attach a FaultPlan (building one if not given) and return
@@ -420,11 +494,15 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         attr = super().__getattribute__(name)
         if name in API_OPS:
             # __dict__ lookup, not self.fault_plan: attribute access
-            # here would recurse, and during __init__ the slot may not
+            # here would recurse, and during __init__ the slots may not
             # exist yet
-            plan = super().__getattribute__("__dict__").get("fault_plan")
+            state = super().__getattribute__("__dict__")
+            persist = state.get("_persist_hook")
+            if persist is not None:
+                attr = persist(name, attr)
+            plan = state.get("fault_plan")
             if plan is not None:
-                return plan.wrap(name, attr)
+                attr = plan.wrap(name, attr)
         return attr
 
     # ------------------------------------------------------------------
@@ -439,19 +517,25 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         lb_type: str = "network",
         scheme: str = "internet-facing",
     ) -> LoadBalancer:
-        arn = (
-            f"arn:aws:elasticloadbalancing:{region}:{_ACCOUNT}:"
-            f"loadbalancer/{'net' if lb_type == 'network' else 'app'}/{name}/{next(self._counter):016x}"
-        )
-        lb = LoadBalancer(
-            load_balancer_arn=arn,
-            load_balancer_name=name,
-            dns_name=dns_name,
-            state_code=state_code,
-            type=lb_type,
-            scheme=scheme,
-        )
         with self._lock:
+            # idempotent on (name, dns): a restarted process re-seeding
+            # the same env-declared LB must not mint a new arn — the
+            # durable state's endpoint groups reference the old one
+            existing = self._load_balancers.get(name)
+            if existing is not None and existing.dns_name == dns_name:
+                return existing
+            arn = (
+                f"arn:aws:elasticloadbalancing:{region}:{_ACCOUNT}:"
+                f"loadbalancer/{'net' if lb_type == 'network' else 'app'}/{name}/{next(self._counter):016x}"
+            )
+            lb = LoadBalancer(
+                load_balancer_arn=arn,
+                load_balancer_name=name,
+                dns_name=dns_name,
+                state_code=state_code,
+                type=lb_type,
+                scheme=scheme,
+            )
             self._load_balancers[name] = lb
         return lb
 
@@ -462,8 +546,13 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
     def add_hosted_zone(self, name: str) -> HostedZone:
         if not name.endswith("."):
             name += "."
-        zone = HostedZone(id=f"/hostedzone/Z{next(self._counter):08X}", name=name)
         with self._lock:
+            # idempotent by name (same rationale as add_load_balancer:
+            # restart re-seeding must not duplicate the zone)
+            for zone in self._zones.values():
+                if zone.name == name:
+                    return zone
+            zone = HostedZone(id=f"/hostedzone/Z{next(self._counter):08X}", name=name)
             self._zones[zone.id] = zone
             self._records.setdefault(zone.id, {})
         return zone
@@ -956,3 +1045,238 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
                 else:
                     table[key] = record
             self.calls.append(("ChangeResourceRecordSets", hosted_zone_id))
+
+
+class FileBackedFakeAWSBackend(FakeAWSBackend):
+    """Durable fake AWS: committed state survives process death.
+
+    Every mutating API call is flushed to a JSON state file (written
+    atomically: tmp + ``os.replace``), and every API call first reloads
+    the file if another process changed it — so a controller process
+    killed mid-mutation leaves behind EXACTLY the AWS state its
+    committed calls created, and the next generation (a restarted
+    controller, a standby manager, or the asserting test) reads that
+    ground truth.  This is what makes real kill-and-restart
+    convergence drills possible with ``AGAC_CLOUD=fake``: without it,
+    the in-memory "AWS" dies with the process and crash consistency is
+    unfalsifiable.
+
+    The persistence seam sits INSIDE the fault plan (see
+    ``FakeAWSBackend.__getattribute__``): a ``fail_after_commit`` or
+    ``crash(op, when="after-commit")`` fires only after the commit hit
+    disk, matching a real backend's view of a dying client.
+
+    Single-writer by design: only the acting leader mutates AWS, so
+    concurrent whole-file writes are not arbitrated beyond atomic
+    replace (the leader-failover drill kills the old leader before the
+    standby starts mutating)."""
+
+    _SEED_HELPERS = frozenset(
+        {"add_load_balancer", "add_hosted_zone", "set_load_balancer_state"}
+    )
+
+    def __init__(self, state_path: str, **kwargs):
+        super().__init__(**kwargs)
+        self._state_path = str(state_path)
+        self._state_stamp: Optional[tuple] = None
+        self._persist_hook = self._persisted
+        self._reload_if_changed()
+
+    # -- the API-op seam (installed via _persist_hook) ------------------
+    def _persisted(self, name: str, call):
+        mutating = name.startswith(_MUTATING_PREFIXES)
+
+        def synced(*args, **kwargs):
+            self._reload_if_changed()
+            result = call(*args, **kwargs)
+            if mutating:
+                self._save()
+            return result
+
+        return synced
+
+    # -- test helpers stay coherent across processes too ----------------
+    def add_load_balancer(self, *args, **kwargs):
+        self._reload_if_changed()
+        lb = super().add_load_balancer(*args, **kwargs)
+        self._save()
+        return lb
+
+    def add_hosted_zone(self, *args, **kwargs):
+        self._reload_if_changed()
+        zone = super().add_hosted_zone(*args, **kwargs)
+        self._save()
+        return zone
+
+    def set_load_balancer_state(self, *args, **kwargs):
+        self._reload_if_changed()
+        super().set_load_balancer_state(*args, **kwargs)
+        self._save()
+
+    def records_in_zone(self, zone_id):
+        self._reload_if_changed()
+        return super().records_in_zone(zone_id)
+
+    def all_accelerator_arns(self):
+        self._reload_if_changed()
+        return super().all_accelerator_arns()
+
+    def zone_id_by_name(self, name: str) -> Optional[str]:
+        """Resolve a zone id by name — the assertion-side lookup a
+        fresh process needs (zone IDS are minted by whichever process
+        seeded first)."""
+        if not name.endswith("."):
+            name += "."
+        self._reload_if_changed()
+        with self._lock:
+            for zone in self._zones.values():
+                if zone.name == name:
+                    return zone.id
+        return None
+
+    # -- serialization ---------------------------------------------------
+    def _encode(self) -> dict:
+        """The complete service state as JSON-able primitives (caller
+        holds ``self._lock``)."""
+
+        def encode_rrs(r: ResourceRecordSet) -> dict:
+            return {
+                "name": r.name,
+                "type": r.type,
+                "ttl": r.ttl,
+                "values": [rr.value for rr in r.resource_records],
+                "alias": dict(vars(r.alias_target)) if r.alias_target else None,
+            }
+
+        return {
+            "counter": self._counter.value,
+            "accelerators": [
+                {
+                    "accelerator": dict(vars(state.accelerator)),
+                    "tags": [[t.key, t.value] for t in state.tags],
+                    "pending_describes": state.pending_describes,
+                    "listeners": [
+                        {
+                            "listener_arn": listener.listener_arn,
+                            "protocol": listener.protocol,
+                            "client_affinity": listener.client_affinity,
+                            "port_ranges": [
+                                [p.from_port, p.to_port] for p in listener.port_ranges
+                            ],
+                        }
+                        for listener in state.listeners.values()
+                    ],
+                }
+                for state in self._accelerators.values()
+            ],
+            "endpoint_groups": [
+                {
+                    "endpoint_group_arn": eg.endpoint_group_arn,
+                    "region": eg.endpoint_group_region,
+                    "parent": self._eg_parent[arn],
+                    "endpoints": [dict(vars(d)) for d in eg.endpoint_descriptions],
+                }
+                for arn, eg in self._endpoint_groups.items()
+            ],
+            "load_balancers": [dict(vars(lb)) for lb in self._load_balancers.values()],
+            "zones": [dict(vars(z)) for z in self._zones.values()],
+            "records": {
+                zone_id: [encode_rrs(r) for r in table.values()]
+                for zone_id, table in self._records.items()
+            },
+        }
+
+    def _apply_state(self, data: dict) -> None:
+        """Replace in-memory state with ``data`` (caller holds
+        ``self._lock``).  The guarded dicts are mutated in place so the
+        racecheck instrumentation survives the reload."""
+        from .types import AliasTarget, ResourceRecord
+
+        self._counter.value = max(self._counter.value, int(data.get("counter", 1)))
+        self._accelerators.clear()
+        self._listener_parent.clear()
+        for entry in data.get("accelerators", []):
+            accelerator = Accelerator(**entry["accelerator"])
+            state = _AcceleratorState(
+                accelerator,
+                [Tag(k, v) for k, v in entry["tags"]],
+                int(entry.get("pending_describes", 0)),
+            )
+            for ldata in entry.get("listeners", []):
+                listener = Listener(
+                    listener_arn=ldata["listener_arn"],
+                    protocol=ldata["protocol"],
+                    client_affinity=ldata["client_affinity"],
+                    port_ranges=[PortRange(f, t) for f, t in ldata["port_ranges"]],
+                )
+                state.listeners[listener.listener_arn] = listener
+                self._listener_parent[listener.listener_arn] = (
+                    accelerator.accelerator_arn
+                )
+            self._accelerators[accelerator.accelerator_arn] = state
+        self._endpoint_groups.clear()
+        self._eg_parent.clear()
+        for entry in data.get("endpoint_groups", []):
+            eg = EndpointGroup(
+                endpoint_group_arn=entry["endpoint_group_arn"],
+                endpoint_group_region=entry["region"],
+                endpoint_descriptions=[
+                    EndpointDescription(**d) for d in entry.get("endpoints", [])
+                ],
+            )
+            self._endpoint_groups[eg.endpoint_group_arn] = eg
+            self._eg_parent[eg.endpoint_group_arn] = entry["parent"]
+        self._load_balancers.clear()
+        for entry in data.get("load_balancers", []):
+            lb = LoadBalancer(**entry)
+            self._load_balancers[lb.load_balancer_name] = lb
+        self._zones.clear()
+        self._records.clear()
+        for entry in data.get("zones", []):
+            zone = HostedZone(**entry)
+            self._zones[zone.id] = zone
+            self._records[zone.id] = {}
+        for zone_id, records in data.get("records", {}).items():
+            table = self._records.setdefault(zone_id, {})
+            for rdata in records:
+                record = ResourceRecordSet(
+                    name=rdata["name"],
+                    type=rdata["type"],
+                    ttl=rdata["ttl"],
+                    resource_records=[ResourceRecord(v) for v in rdata["values"]],
+                    alias_target=(
+                        AliasTarget(**rdata["alias"]) if rdata["alias"] else None
+                    ),
+                )
+                table[(record.name, record.type)] = record
+
+    # -- the file ---------------------------------------------------------
+    def _stat_stamp(self) -> Optional[tuple]:
+        try:
+            stat = os.stat(self._state_path)
+        except FileNotFoundError:
+            return None
+        return (stat.st_mtime_ns, stat.st_size)
+
+    def _save(self) -> None:
+        with self._lock:
+            payload = json.dumps(self._encode())
+        tmp = f"{self._state_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        # atomic replace: a reader (or a process killed mid-save) can
+        # never observe a torn file
+        os.replace(tmp, self._state_path)
+        self._state_stamp = self._stat_stamp()
+
+    def _reload_if_changed(self) -> None:
+        stamp = self._stat_stamp()
+        if stamp is None or stamp == self._state_stamp:
+            return
+        with open(self._state_path) as f:
+            data = json.load(f)
+        with self._lock:
+            self._apply_state(data)
+        self._state_stamp = stamp

@@ -125,6 +125,30 @@ def build_parser() -> argparse.ArgumentParser:
         "breaking only). Default 20 (env AGAC_API_HEALTH_AIMD_QPS).",
     )
     controller.add_argument(
+        "--gc-interval", type=float, default=0.0,
+        help="Seconds between orphan-GC sweeps: cross-check every "
+        "cluster-tagged accelerator and owner-TXT'd Route53 record "
+        "against the apiserver and tear down confirmed orphans (a "
+        "Service deleted during a controller outage is otherwise a "
+        "permanent leak). 0 (default) disables — reference parity.",
+    )
+    controller.add_argument(
+        "--gc-grace-sweeps", type=int, default=2,
+        help="Consecutive sweeps an orphan must be observed before "
+        "deletion; disappearing from one sweep resets the counter.",
+    )
+    controller.add_argument(
+        "--gc-max-deletes", type=int, default=10,
+        help="Per-sweep deletion budget (accelerators + record owners "
+        "combined) — bounds blast radius of a mass-orphan event.",
+    )
+    controller.add_argument(
+        "--gc-dry-run", action="store_true",
+        help="GC observes and logs would-be deletions without touching "
+        "AWS — the recommended first rollout step (watch the gc "
+        "counters on /healthz).",
+    )
+    controller.add_argument(
         "--read-plane-ttl", type=float, default=None,
         help="Tick scope (seconds) of the coalesced verification read "
         "plane: accelerator-topology, record-set and load-balancer "
@@ -177,10 +201,11 @@ def run_controller(args) -> int:
     from ..cluster.rest import build_client
     from ..controllers import (
         EndpointGroupBindingConfig,
+        GarbageCollectorConfig,
         GlobalAcceleratorConfig,
         Route53Config,
     )
-    from ..leaderelection import LeaderElection
+    from ..leaderelection import LeaderElection, LeaderElectionConfig
     from ..manager import ControllerConfig, Manager
     from ..signals import setup_signal_handler
 
@@ -213,6 +238,13 @@ def run_controller(args) -> int:
         endpoint_group_binding=EndpointGroupBindingConfig(
             workers=args.workers, **queue_limits
         ),
+        garbage_collector=GarbageCollectorConfig(
+            interval=args.gc_interval,
+            grace_sweeps=args.gc_grace_sweeps,
+            max_deletes=args.gc_max_deletes,
+            dry_run=args.gc_dry_run,
+            cluster_name=args.cluster_name,
+        ),
     )
     stop = setup_signal_handler()
 
@@ -233,11 +265,14 @@ def run_controller(args) -> int:
         aimd_qps=args.api_health_aimd_qps,
     )
     tracker = shared_health_tracker()
+    manager = Manager(health=tracker)
 
     if args.health_port > 0:
         from ..manager import make_health_server
 
-        health_server = make_health_server(args.health_port, health=tracker)
+        health_server = make_health_server(
+            args.health_port, health=tracker, gc_status=manager.gc_status
+        )
         import threading
 
         threading.Thread(
@@ -245,7 +280,7 @@ def run_controller(args) -> int:
         ).start()
 
     def run_manager(stop_event):
-        Manager(health=tracker).run(
+        manager.run(
             client, config, stop_event, cloud_factory=real_cloud_factory, block=True
         )
 
@@ -253,7 +288,24 @@ def run_controller(args) -> int:
         run_manager(stop)
         return 0
 
-    election = LeaderElection("aws-global-accelerator-controller", namespace)
+    # lease timing env overrides: the kill-recovery / leader-failover
+    # drills need sub-second takeover, production keeps the reference's
+    # 60/15/5 defaults
+    defaults = LeaderElectionConfig()
+    lease_config = LeaderElectionConfig(
+        lease_duration=float(
+            os.environ.get("AGAC_LEASE_DURATION", defaults.lease_duration)
+        ),
+        renew_deadline=float(
+            os.environ.get("AGAC_LEASE_RENEW_DEADLINE", defaults.renew_deadline)
+        ),
+        retry_period=float(
+            os.environ.get("AGAC_LEASE_RETRY_PERIOD", defaults.retry_period)
+        ),
+    )
+    election = LeaderElection(
+        "aws-global-accelerator-controller", namespace, config=lease_config
+    )
     election.run(
         client,
         run_manager,

@@ -9,6 +9,7 @@ from .endpointgroupbinding import (
     EndpointGroupBindingConfig,
     EndpointGroupBindingController,
 )
+from .garbagecollector import GarbageCollector, GarbageCollectorConfig
 
 __all__ = [
     "GlobalAcceleratorController",
@@ -17,4 +18,6 @@ __all__ = [
     "Route53Config",
     "EndpointGroupBindingController",
     "EndpointGroupBindingConfig",
+    "GarbageCollector",
+    "GarbageCollectorConfig",
 ]

@@ -20,6 +20,13 @@ surfaces stuck workers, and ``make_health_server`` serves
 ``/healthz`` + ``/readyz`` (stdlib server, same pattern as
 ``webhook/server.py``) reporting per-circuit state and worker
 liveness for deployment probes.
+
+And the crash-recovery plane (ISSUE 4): when
+``ControllerConfig.garbage_collector.interval > 0`` the manager runs
+the orphan GC sweeper (``controllers/garbagecollector.py``) on its own
+daemon thread, sharing the controllers' informer caches and cloud
+factory; ``gc_sweep()`` drives one sweep explicitly (bench/tests, the
+``drift_tick`` pattern) and ``/healthz`` carries ``gc_status()``.
 """
 
 from __future__ import annotations
@@ -36,6 +43,8 @@ from .cluster import ClusterClient, SharedInformerFactory
 from .controllers import (
     EndpointGroupBindingConfig,
     EndpointGroupBindingController,
+    GarbageCollector,
+    GarbageCollectorConfig,
     GlobalAcceleratorConfig,
     GlobalAcceleratorController,
     Route53Config,
@@ -59,6 +68,10 @@ class ControllerConfig:
     route53: Route53Config = field(default_factory=Route53Config)
     endpoint_group_binding: EndpointGroupBindingConfig = field(
         default_factory=EndpointGroupBindingConfig
+    )
+    # the orphan GC sweeper (ISSUE 4); interval 0 (default) disables
+    garbage_collector: GarbageCollectorConfig = field(
+        default_factory=GarbageCollectorConfig
     )
 
 
@@ -98,6 +111,9 @@ class Manager:
         # {"enqueued": {controller: n}, "skipped": {controller: [svc]},
         #  "partial": bool}
         self.last_drift_report: dict = {}
+        # the orphan GC sweeper (ISSUE 4), built by run() when its
+        # interval is > 0; None = disabled (reference parity)
+        self.gc: Optional[GarbageCollector] = None
 
     def run(
         self,
@@ -122,6 +138,20 @@ class Manager:
             thread.start()
             threads.append(thread)
             klog.infof("Started %s", name)
+
+        gc_config = config.garbage_collector
+        if gc_config.interval > 0 and cloud_factory is not None:
+            # the sweeper shares the controllers' informer caches (its
+            # owner cross-checks must see the same world the reconciles
+            # do) and the same cloud factory (deletes flow through the
+            # shaped drivers); it never sweeps before those caches sync
+            self.gc = GarbageCollector(
+                informer_factory, gc_config, cloud_factory, health=self._health
+            )
+            threading.Thread(
+                target=self.gc.run, args=(stop,), daemon=True,
+                name="garbage-collector",
+            ).start()
 
         informer_factory.start(stop)
         api_health.start_worker_watchdog(stop, self.heartbeats)
@@ -197,6 +227,22 @@ class Manager:
         self.last_drift_report = report
         return enqueued
 
+    def gc_sweep(self) -> dict:
+        """Drive ONE orphan-GC sweep explicitly (tests and the bench's
+        gc-sweep phase; same pattern as ``drift_tick``).  No-op when
+        the sweeper is disabled."""
+        if self.gc is None:
+            return {}
+        return self.gc.sweep_once()
+
+    def gc_status(self) -> dict:
+        """The sweeper's counters for ``/healthz`` and bench_detail:
+        cumulative totals, pending (grace-held) depths, and the last
+        sweep's full report."""
+        if self.gc is None:
+            return {"enabled": False}
+        return self.gc.status()
+
 
 # ---------------------------------------------------------------------------
 # /healthz + /readyz (stdlib server, the webhook/server.py pattern)
@@ -233,6 +279,10 @@ class _HealthHandler(BaseHTTPRequestHandler):
                 {"worker": worker, "key": key, "age": round(age, 1)}
                 for worker, key, age in stuck
             ],
+            # orphan-GC sweep status (ISSUE 4): operators watching a
+            # dry-run rollout read would-delete counts here instead of
+            # grepping logs
+            "gc": self.server.gc_status(),
         }
         self._respond(500 if stuck else 200, body)
 
@@ -264,12 +314,15 @@ def make_health_server(
     heartbeats: Optional["api_health.WorkerHeartbeats"] = None,
     stuck_threshold: float = WORKER_STUCK_THRESHOLD,
     host: str = "",
+    gc_status: Optional[Callable[[], dict]] = None,
 ) -> ThreadingHTTPServer:
     """Build the manager's health endpoint (bind port 0 in tests);
-    call ``serve_forever`` on a daemon thread to serve."""
+    call ``serve_forever`` on a daemon thread to serve.  ``gc_status``
+    is the manager's ``gc_status`` hook (defaults to disabled)."""
     server = ThreadingHTTPServer((host, port), _HealthHandler)
     server.health_tracker = health
     server.heartbeats = heartbeats or api_health.worker_heartbeats()
     server.stuck_threshold = stuck_threshold
+    server.gc_status = gc_status or (lambda: {"enabled": False})
     klog.infof("Health endpoint listening on :%d", server.server_address[1])
     return server

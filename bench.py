@@ -111,6 +111,7 @@ from agac_tpu.reconcile import (
 )
 from agac_tpu.controllers import (
     EndpointGroupBindingConfig,
+    GarbageCollectorConfig,
     GlobalAcceleratorConfig,
     Route53Config,
 )
@@ -989,6 +990,12 @@ def run_drift_tick(n: int, workers: int) -> dict:
             workers=workers, queue_qps=100000.0, queue_burst=100000,
             drift_resync_period=dormant,
         ),
+        # GC sweeper with a dormant interval: sweeps are driven
+        # explicitly below (the drift_tick pattern) so the phase
+        # measures exactly two sweeps over a fully-live fleet
+        garbage_collector=GarbageCollectorConfig(
+            interval=dormant, grace_sweeps=2, max_deletes=10
+        ),
     )
     # the informer resync is dormant too (not RESYNC_PERIOD): a 30s
     # resync firing during the tick drain would attribute its
@@ -1033,6 +1040,20 @@ def run_drift_tick(n: int, workers: int) -> dict:
         _wait_quiescent(aws, quiet_need, deadline)
         drain = round(time.monotonic() - tick_start - quiet_need, 2)
         tick_ops = _ops_delta(before, aws.snapshot_counts())
+        # GC-sweep phase (ISSUE 4): two explicit sweeps over the same
+        # converged, fully-live fleet — at scale the sweeper must find
+        # zero orphans and delete NOTHING (the zero-false-positive bar
+        # the chaos tier's orphan storm drills at N=25), and the sweep
+        # counters land in bench_detail.json
+        gc_before = aws.snapshot_counts()
+        manager.gc_sweep()
+        gc_report = manager.gc_sweep()
+        gc_ops = _ops_delta(gc_before, aws.snapshot_counts())
+        gc_status = manager.gc_status()
+        if gc_status.get("deleted_total", 0):
+            raise SystemExit(
+                f"gc sweep falsely deleted live resources: {gc_status}"
+            )
     finally:
         stop.set()
 
@@ -1065,6 +1086,17 @@ def run_drift_tick(n: int, workers: int) -> dict:
         # partial=False; a brownout tick says so instead of silently
         # under-reading (ISSUE 3)
         "health": manager.last_drift_report,
+        # orphan-GC sweep over the converged fleet (ISSUE 4): the
+        # second sweep's counters + cumulative status; a healthy fleet
+        # reads candidates 0 / deleted 0 (zero false positives at
+        # scale), and aws_calls shows the two sweeps' read cost
+        # (discovery snapshot + per-zone record lists via the read
+        # plane)
+        "gc_sweep": {
+            "last_sweep": gc_report,
+            "status": gc_status,
+            "aws_calls": sum(gc_ops.values()),
+        },
         "note": (
             "counts measured over one isolated ticker round on a converged "
             "fleet (coalesced read plane at ~1 s tick scope so the round "

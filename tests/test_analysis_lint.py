@@ -439,6 +439,88 @@ class TestUnboundedPollLoop:
 
 
 # ---------------------------------------------------------------------------
+# delete-without-ownership-check
+# ---------------------------------------------------------------------------
+
+
+class TestDeleteWithoutOwnershipCheck:
+    GC = "agac_tpu/controllers/garbagecollector.py"
+
+    def test_unverified_cleanup_fires_once(self):
+        v = only(
+            run(
+                """
+                class GarbageCollector:
+                    def _sweep(self, cloud, arn):
+                        cloud.cleanup_global_accelerator(arn)
+                """,
+                path=self.GC,
+            ),
+            "delete-without-ownership-check",
+        )
+        assert "ownership-verification" in v.message
+
+    def test_unverified_record_delete_fires(self):
+        only(
+            run(
+                """
+                class GarbageCollector:
+                    def _sweep(self, cloud, owner):
+                        cloud.cleanup_record_set("c", *owner)
+                """,
+                path=self.GC,
+            ),
+            "delete-without-ownership-check",
+        )
+
+    def test_verified_funnel_is_clean(self):
+        assert (
+            run(
+                """
+                class GarbageCollector:
+                    def _delete_orphan(self, cloud, arn, owner):
+                        if not verify_accelerator_orphan_ownership(
+                            cloud, arn, self._cluster, owner, self._owner_exists
+                        ):
+                            return False
+                        cloud.cleanup_global_accelerator(arn)
+                        return True
+                """,
+                path=self.GC,
+            )
+            == []
+        )
+
+    def test_verify_helper_itself_is_sanctioned(self):
+        # the helper's own live pre-deletion reads/deletes are the
+        # sanctioned site (it IS the verification)
+        assert (
+            run(
+                """
+                def verify_record_orphan_ownership(cloud, cluster, owner):
+                    cloud.cleanup_record_set(cluster, *owner)
+                """,
+                path=self.GC,
+            )
+            == []
+        )
+
+    def test_rule_is_scoped_to_the_gc_module(self):
+        # the reactive controllers' cleanups are owner-event-driven —
+        # the rule targets the sweeper's self-initiated deletions
+        assert (
+            run(
+                """
+                def process_delete(self, cloud, arn):
+                    cloud.cleanup_global_accelerator(arn)
+                """,
+                path="agac_tpu/controllers/globalaccelerator.py",
+            )
+            == []
+        )
+
+
+# ---------------------------------------------------------------------------
 # the repo itself + CI wiring
 # ---------------------------------------------------------------------------
 
@@ -453,6 +535,7 @@ def test_rule_registry_ships_the_documented_rules():
         "unguarded-optional-import",
         "drift-read-outside-read-plane",
         "unbounded-poll-loop",
+        "delete-without-ownership-check",
     }
 
 

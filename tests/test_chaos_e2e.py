@@ -530,6 +530,111 @@ class TestChaosFleet:
             server.shutdown()
             server.server_close()
 
+    def test_orphan_storm_swept_after_outage_with_zero_false_positives(self):
+        """The ISSUE 4 orphan-storm drill: 25 Services deleted while
+        the controller is DOWN (the delete events are gone forever —
+        the next generation's informer relist cannot replay them), a
+        fresh generation starts with the GC sweeper enabled, and:
+
+        - every orphaned accelerator chain and owned record pair is
+          torn down within grace + budget sweeps;
+        - ZERO deletions touch resources whose Kubernetes owner still
+          exists — survivors' chains and records are bit-identical.
+        """
+        n_total, n_orphan, n_r53 = 30, 25, 6
+        cluster = FakeCluster()
+        aws = FakeAWSBackend(quota_accelerators=2 * n_total)
+        zone = aws.add_hosted_zone("example.com")
+        for i in range(n_total):
+            aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+            annotations = {}
+            # r53 hostnames on the first 6 (all orphaned) and the
+            # first 2 survivors — record GC and record survival both
+            # get exercised
+            if i < n_r53 or i in (n_orphan, n_orphan + 1):
+                annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = f"app{i}.example.com"
+            cluster.create(
+                "Service",
+                make_lb_service(
+                    name=f"svc{i}", hostname=nlb_hostname(i), annotations=annotations
+                ),
+            )
+
+        gen1 = start_manager(cluster, aws, config=fleet_config(workers=3))
+        try:
+            assert wait_until(
+                lambda: len(aws.all_accelerator_arns()) == n_total, timeout=30.0
+            )
+            assert wait_until(
+                lambda: {
+                    (f"app{i}.example.com.", "A")
+                    for i in list(range(n_r53)) + [n_orphan, n_orphan + 1]
+                }
+                <= {(r.name, r.type) for r in aws.records_in_zone(zone.id)},
+                timeout=30.0,
+            )
+        finally:
+            gen1.set()  # the controller outage
+        time.sleep(0.2)
+
+        arn_owner = {
+            arn: {t.key: t.value for t in aws.list_tags_for_resource(arn)}[
+                "aws-global-accelerator-owner"
+            ]
+            for arn in aws.all_accelerator_arns()
+        }
+        orphan_owners = {f"service/default/svc{i}" for i in range(n_orphan)}
+        orphan_arns = {a for a, o in arn_owner.items() if o in orphan_owners}
+        live_arns = set(arn_owner) - orphan_arns
+        assert len(orphan_arns) == n_orphan
+
+        # the storm: deleted with nobody watching
+        for i in range(n_orphan):
+            cluster.delete("Service", "default", f"svc{i}")
+
+        from agac_tpu.controllers import GarbageCollectorConfig
+
+        config = fleet_config(workers=3)
+        config.garbage_collector = GarbageCollectorConfig(
+            interval=0.05, grace_sweeps=2, max_deletes=10
+        )
+        gen2 = start_manager(cluster, aws, config=config)
+        try:
+            def swept():
+                if set(aws.all_accelerator_arns()) != live_arns:
+                    return False
+                names_now = {
+                    (r.name, r.type) for r in aws.records_in_zone(zone.id)
+                }
+                return all(
+                    (f"app{i}.example.com.", "A") not in names_now
+                    for i in range(n_r53)
+                )
+
+            assert wait_until(swept, timeout=30.0)
+            names = {(r.name, r.type) for r in aws.records_in_zone(zone.id)}
+            for i in range(n_r53):
+                assert (f"app{i}.example.com.", "A") not in names
+                assert (f"app{i}.example.com.", "TXT") not in names
+            # survivors: chains complete, records intact, untouched by
+            # any deletion the sweeper issued
+            for i in range(n_orphan, n_total):
+                assert chain_complete(
+                    aws, f"service/default/svc{i}", nlb_hostname(i)
+                ), f"survivor svc{i} chain damaged"
+            for i in (n_orphan, n_orphan + 1):
+                assert (f"app{i}.example.com.", "A") in names
+                assert (f"app{i}.example.com.", "TXT") in names
+            deleted_arns = {
+                c[1] for c in aws.calls if c[0] == "DeleteAccelerator"
+            }
+            assert deleted_arns == orphan_arns, (
+                "sweeper deleted a resource whose owner still exists: "
+                f"{deleted_arns - orphan_arns}"
+            )
+        finally:
+            gen2.set()
+
     def test_concurrent_workers_create_no_duplicates(self):
         """12 services, 4 workers, no faults: exactly one
         CreateAccelerator per service — the workqueue's same-key

@@ -1,0 +1,418 @@
+"""Unit tier for the orphan GC sweeper (ISSUE 4 tentpole,
+``agac_tpu/controllers/garbagecollector.py``).
+
+The sweeper deletes resources nobody asked it to touch, so this tier
+is mostly about the FAIL-CLOSED rails: the grace-period state machine
+(consecutive observation before deletion), the per-sweep deletion
+budget, refusing to conclude anything from an unsynced informer or a
+failed listing, dry-run mode, circuit-open skips, adoption of
+re-created owners, and never touching resources whose ownership
+cannot be parsed.  The /healthz surfacing of the sweep counters is
+pinned here too.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from agac_tpu import apis
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.driver import (
+    CLUSTER_TAG_KEY,
+    MANAGED_TAG_KEY,
+    OWNER_TAG_KEY,
+)
+from agac_tpu.cloudprovider.aws.fake_backend import FaultPlan
+from agac_tpu.cloudprovider.aws.health import (
+    OUTCOME_SERVER_ERROR,
+    HealthConfig,
+    HealthTracker,
+)
+from agac_tpu.cloudprovider.aws.types import Tag
+from agac_tpu.cluster import FakeCluster, SharedInformerFactory
+from agac_tpu.controllers import GarbageCollector, GarbageCollectorConfig
+from agac_tpu.manager import make_health_server
+
+from .fixtures import NLB_REGION, make_lb_service
+
+
+def nlb_hostname(i: int) -> str:
+    return f"lb{i}-0123456789abcdef.elb.{NLB_REGION}.amazonaws.com"
+
+
+def wait_until(pred, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class World:
+    """Cluster + fake AWS + synced informers + a driver — the sweeping
+    surface without any reactive controllers running."""
+
+    def __init__(self, synced: bool = True):
+        self.cluster = FakeCluster()
+        self.aws = FakeAWSBackend(quota_accelerators=100)
+        self.zone = self.aws.add_hosted_zone("example.com")
+        self.stop = threading.Event()
+        self.factory = SharedInformerFactory(self.cluster, resync_period=30.0)
+        self.factory.informer("Service")
+        self.factory.informer("Ingress")
+        if synced:
+            self.factory.start(self.stop)
+            assert self.factory.wait_for_cache_sync(self.stop)
+        self.driver = AWSDriver(
+            self.aws, self.aws, self.aws, poll_interval=0.01, poll_timeout=2.0
+        )
+
+    def gc(self, health=None, **overrides) -> GarbageCollector:
+        config = GarbageCollectorConfig(interval=0.01, **overrides)
+        return GarbageCollector(
+            self.factory, config, lambda region: self.driver, health=health
+        )
+
+    def make_orphan(self, i: int = 0, hostnames: tuple = ()):
+        """A full accelerator chain (and optional TXT/A record pairs)
+        whose Kubernetes owner does NOT exist in the cluster — the
+        exact state a Service deleted during a controller outage
+        leaves behind."""
+        self.aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+        svc = make_lb_service(name=f"ghost{i}", hostname=nlb_hostname(i))
+        arn, _, _ = self.driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "default", f"lb{i}", NLB_REGION
+        )
+        for hostname in hostnames:
+            created, _ = self.driver.ensure_route53_for_service(
+                svc, svc.status.load_balancer.ingress[0], [hostname], "default"
+            )
+            assert created
+        return arn, svc
+
+    def record_names(self) -> set:
+        return {(r.name, r.type) for r in self.aws.records_in_zone(self.zone.id)}
+
+
+@pytest.fixture
+def world():
+    w = World()
+    yield w
+    w.stop.set()
+
+
+class TestGraceStateMachine:
+    def test_orphan_needs_consecutive_sweeps_before_deletion(self, world):
+        arn, _ = world.make_orphan(0, hostnames=("app0.example.com",))
+        gc = world.gc(grace_sweeps=2)
+
+        report = gc.sweep_once()
+        assert report["candidates"] == {"accelerators": 1, "records": 1}
+        assert report["grace_held"] == 2
+        assert report["deleted"] == {"accelerators": 0, "records": 0}
+        assert world.aws.all_accelerator_arns() == [arn]  # grace held
+
+        report = gc.sweep_once()
+        assert report["deleted"] == {"accelerators": 1, "records": 1}
+        assert world.aws.all_accelerator_arns() == []
+        assert world.record_names() == set()  # TXT and A both gone
+
+    def test_live_owner_is_never_a_candidate(self, world):
+        world.aws.add_load_balancer("lb0", NLB_REGION, nlb_hostname(0))
+        svc = make_lb_service(name="alive", hostname=nlb_hostname(0))
+        world.cluster.create("Service", svc)
+        assert wait_until(
+            lambda: gc_sees_service(world, "alive")
+        ), "informer never saw the Service"
+        world.driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "default", "lb0", NLB_REGION
+        )
+        gc = world.gc(grace_sweeps=1)
+        for _ in range(3):
+            report = gc.sweep_once()
+            assert report["candidates"] == {"accelerators": 0, "records": 0}
+            assert report["deleted"] == {"accelerators": 0, "records": 0}
+        assert len(world.aws.all_accelerator_arns()) == 1
+
+    def test_recreated_owner_is_adopted_not_deleted(self, world):
+        arn, svc = world.make_orphan(0)
+        gc = world.gc(grace_sweeps=2)
+        report = gc.sweep_once()
+        assert report["grace_held"] == 1
+
+        # the owner comes back (Service re-created with the same name)
+        # between observation and deletion: adopt, never delete
+        world.cluster.create("Service", svc)
+        assert wait_until(lambda: gc_sees_service(world, "ghost0"))
+        report = gc.sweep_once()
+        assert report["adopted"] == 1
+        assert report["deleted"] == {"accelerators": 0, "records": 0}
+        assert world.aws.all_accelerator_arns() == [arn]
+        assert gc.status()["pending"] == {"accelerators": 0, "records": 0}
+
+    def test_disappearing_candidate_resets_grace(self, world):
+        arn, _ = world.make_orphan(0)
+        gc = world.gc(grace_sweeps=2)
+        gc.sweep_once()
+        assert gc.status()["pending"]["accelerators"] == 1
+        # the orphan vanishes out-of-band (another actor deleted it):
+        # the pending entry is dropped, not carried toward deletion
+        world.driver.cleanup_global_accelerator(arn)
+        gc.sweep_once()
+        assert gc.status()["pending"]["accelerators"] == 0
+
+
+class TestBudgetAndDryRun:
+    def test_deletion_budget_clamps_each_sweep(self, world):
+        for i in range(5):
+            world.make_orphan(i)
+        gc = world.gc(grace_sweeps=1, max_deletes=2)
+
+        report = gc.sweep_once()
+        assert report["deleted"]["accelerators"] == 2
+        assert report["budget_deferred"] == 3
+        assert len(world.aws.all_accelerator_arns()) == 3
+
+        report = gc.sweep_once()
+        assert report["deleted"]["accelerators"] == 2
+        report = gc.sweep_once()
+        assert report["deleted"]["accelerators"] == 1
+        assert world.aws.all_accelerator_arns() == []
+
+    def test_budget_is_shared_across_accelerators_and_records(self, world):
+        world.make_orphan(0, hostnames=("app0.example.com",))
+        gc = world.gc(grace_sweeps=1, max_deletes=1)
+        report = gc.sweep_once()
+        # one deletion total: the record owner waits for the next sweep
+        assert report["deleted"]["accelerators"] + report["deleted"]["records"] == 1
+        assert report["budget_deferred"] == 1
+        report = gc.sweep_once()
+        assert report["deleted"]["accelerators"] + report["deleted"]["records"] == 1
+        assert world.aws.all_accelerator_arns() == []
+        assert world.record_names() == set()
+
+    def test_dry_run_observes_but_never_deletes(self, world):
+        arn, _ = world.make_orphan(0, hostnames=("app0.example.com",))
+        gc = world.gc(grace_sweeps=1, dry_run=True)
+        for _ in range(3):
+            report = gc.sweep_once()
+            assert report["would_delete"] == 2  # accelerator + record owner
+            assert report["deleted"] == {"accelerators": 0, "records": 0}
+        assert world.aws.all_accelerator_arns() == [arn]
+        assert world.record_names() != set()
+
+        # flipping dry-run off deletes what dry-run kept observing
+        live = world.gc(grace_sweeps=1, dry_run=False)
+        live.sweep_once()
+        assert world.aws.all_accelerator_arns() == []
+        assert world.record_names() == set()
+
+
+class TestFailClosedRails:
+    def test_unsynced_informers_skip_the_sweep(self):
+        w = World(synced=False)
+        try:
+            w.make_orphan(0, hostnames=("app0.example.com",))
+            gc = w.gc(grace_sweeps=1)
+            report = gc.sweep_once()
+            assert report["skipped_unsynced"] is True
+            assert report["candidates"] == {"accelerators": 0, "records": 0}
+            assert len(w.aws.all_accelerator_arns()) == 1
+            assert gc.status()["pending"] == {"accelerators": 0, "records": 0}
+        finally:
+            w.stop.set()
+
+    def test_failed_listing_freezes_grace_state(self, world):
+        world.make_orphan(0)
+        gc = world.gc(grace_sweeps=2)
+        gc.sweep_once()  # observation 1
+
+        plan = world.aws.install_fault_plan(FaultPlan(exempt_creator=False))
+        plan.outage("list_accelerators")
+        report = gc.sweep_once()
+        assert report["listing_failed"] == ["accelerators"]
+        assert report["deleted"] == {"accelerators": 0, "records": 0}
+        # the failed sweep neither advanced nor reset the counter
+        assert gc.status()["pending"]["accelerators"] == 1
+
+        plan.restore()
+        report = gc.sweep_once()  # observation 2 — grace met
+        assert report["deleted"]["accelerators"] == 1
+        assert world.aws.all_accelerator_arns() == []
+
+    def test_open_circuit_skips_the_phase(self, world):
+        world.make_orphan(0, hostnames=("app0.example.com",))
+        tracker = HealthTracker(
+            HealthConfig(
+                window=60.0, min_calls=1, failure_ratio=0.5,
+                open_duration=60.0, aimd_qps=0,
+            )
+        )
+        tracker.service("globalaccelerator").record(OUTCOME_SERVER_ERROR)
+        assert tracker.is_open("globalaccelerator")
+        gc = world.gc(health=tracker, grace_sweeps=1)
+        report = gc.sweep_once()
+        assert "globalaccelerator" in report["skipped_circuit_open"]
+        assert report["deleted"]["accelerators"] == 0
+        assert len(world.aws.all_accelerator_arns()) == 1
+
+        tracker.service("route53").record(OUTCOME_SERVER_ERROR)
+        report = gc.sweep_once()
+        assert "route53" in report["skipped_circuit_open"]
+        assert report["deleted"]["records"] == 0
+
+    def test_unparseable_owner_tag_is_never_deleted(self, world):
+        world.aws.create_accelerator(
+            "mystery", "IPV4", True,
+            [
+                Tag(MANAGED_TAG_KEY, "true"),
+                Tag(CLUSTER_TAG_KEY, "default"),
+                Tag(OWNER_TAG_KEY, "not-an-owner-identity"),
+            ],
+        )
+        gc = world.gc(grace_sweeps=1)
+        for _ in range(3):
+            report = gc.sweep_once()
+            assert report["candidates"]["accelerators"] == 0
+        assert len(world.aws.all_accelerator_arns()) == 1
+
+    def test_unknown_resource_kind_is_never_deleted(self, world):
+        world.aws.create_accelerator(
+            "cron", "IPV4", True,
+            [
+                Tag(MANAGED_TAG_KEY, "true"),
+                Tag(CLUSTER_TAG_KEY, "default"),
+                Tag(OWNER_TAG_KEY, "cronjob/default/mystery"),
+            ],
+        )
+        gc = world.gc(grace_sweeps=1)
+        gc.sweep_once()
+        gc.sweep_once()
+        assert len(world.aws.all_accelerator_arns()) == 1
+
+    def test_foreign_cluster_resources_are_invisible(self, world):
+        # another cluster's accelerator + records share the AWS account
+        world.aws.add_load_balancer("lb9", NLB_REGION, nlb_hostname(9))
+        svc = make_lb_service(name="theirs", hostname=nlb_hostname(9))
+        world.driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "other-cluster", "lb9", NLB_REGION
+        )
+        world.driver.ensure_route53_for_service(
+            svc, svc.status.load_balancer.ingress[0],
+            ["their.example.com"], "other-cluster",
+        )
+        gc = world.gc(grace_sweeps=1)  # cluster_name=default
+        for _ in range(3):
+            report = gc.sweep_once()
+            assert report["candidates"] == {"accelerators": 0, "records": 0}
+        assert len(world.aws.all_accelerator_arns()) == 1
+        assert ("their.example.com.", "A") in world.record_names()
+
+
+class TestObservability:
+    def test_status_carries_totals_and_last_sweep(self, world):
+        world.make_orphan(0)
+        gc = world.gc(grace_sweeps=1)
+        gc.sweep_once()
+        status = gc.status()
+        assert status["enabled"] is True
+        assert status["sweeps_total"] == 1
+        assert status["deleted_total"] == 1
+        assert status["last_sweep"]["deleted"]["accelerators"] == 1
+        for key in ("grace_sweeps", "max_deletes", "dry_run", "interval"):
+            assert key in status
+
+    def test_healthz_surfaces_gc_counters(self, world):
+        world.make_orphan(0)
+        gc = world.gc(grace_sweeps=1, dry_run=True)
+        gc.sweep_once()
+        server = make_health_server(0, gc_status=gc.status)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{server.server_address[1]}/healthz"
+            with urllib.request.urlopen(url, timeout=5) as response:
+                body = json.loads(response.read())
+            assert body["gc"]["enabled"] is True
+            assert body["gc"]["dry_run"] is True
+            assert body["gc"]["last_sweep"]["would_delete"] == 1
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_disabled_gc_reports_disabled_on_healthz(self):
+        server = make_health_server(0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{server.server_address[1]}/healthz"
+            with urllib.request.urlopen(url, timeout=5) as response:
+                body = json.loads(response.read())
+            assert body["gc"] == {"enabled": False}
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+class TestManagerWiring:
+    def test_manager_runs_the_sweeper_and_mops_orphans(self, world):
+        """End-to-end through the manager: an orphan left by a dead
+        generation is swept by a manager whose config enables GC —
+        while a live owner's chain is untouched."""
+        from agac_tpu.manager import ControllerConfig, Manager
+
+        orphan_arn, _ = world.make_orphan(0, hostnames=("app0.example.com",))
+        world.aws.add_load_balancer("lb1", NLB_REGION, nlb_hostname(1))
+        world.cluster.create(
+            "Service",
+            make_lb_service(
+                name="alive",
+                hostname=nlb_hostname(1),
+                annotations={apis.ROUTE53_HOSTNAME_ANNOTATION: "live.example.com"},
+            ),
+        )
+        stop = threading.Event()
+        config = ControllerConfig(
+            garbage_collector=GarbageCollectorConfig(
+                interval=0.05, grace_sweeps=2, max_deletes=10
+            )
+        )
+        manager = Manager(resync_period=0.3)
+        manager.run(
+            world.cluster, config, stop,
+            cloud_factory=lambda region: AWSDriver(
+                world.aws, world.aws, world.aws,
+                poll_interval=0.01, poll_timeout=2.0,
+                lb_not_active_retry=0.05, accelerator_missing_retry=0.05,
+            ),
+            block=False,
+        )
+        try:
+            assert manager.gc is not None
+            assert wait_until(
+                lambda: orphan_arn not in world.aws.all_accelerator_arns(),
+                timeout=10.0,
+            ), manager.gc_status()
+            # the live service converged and survived every sweep
+            assert wait_until(
+                lambda: ("live.example.com.", "A") in world.record_names(),
+                timeout=10.0,
+            )
+            assert len(world.aws.all_accelerator_arns()) == 1
+            assert ("app0.example.com.", "A") not in world.record_names()
+            assert manager.gc_status()["deleted_total"] >= 2
+        finally:
+            stop.set()
+
+
+def gc_sees_service(world: World, name: str) -> bool:
+    informer = world.factory.informer("Service")
+    try:
+        informer.lister().namespaced("default").get(name)
+        return True
+    except Exception:
+        return False

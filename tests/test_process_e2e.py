@@ -4,7 +4,18 @@ HTTP apiserver and ``AGAC_CLOUD=fake``, then observe — through the
 apiserver only, like an operator with kubectl — the leader lease being
 acquired and a Service convergence event being emitted.  This is the
 deepest analog of the reference's kind e2e: the actual binary, the
-actual wire protocol, graceful SIGTERM shutdown."""
+actual wire protocol, graceful SIGTERM shutdown.
+
+The kill-recovery drills (ISSUE 4) build on two seams: the fake AWS
+made DURABLE via ``AGAC_FAKE_STATE`` (a JSON state file shared across
+process generations — the ground truth that outlives a crash), and
+``AGAC_FAKE_CRASH=op:when`` which hard-kills the process with
+``os._exit(137)`` at an exact API-call boundary (``FaultPlan.crash``
+— the in-repo ``kill -9``).  Each drill kills a real controller
+process mid-mutation, restarts a fresh generation, and asserts from
+the durable state file that the successor converges to zero orphans —
+including the case only the GC sweeper can fix (a Service whose
+delete event died with the old process)."""
 
 import os
 import signal
@@ -15,12 +26,35 @@ import time
 
 import yaml
 
+from agac_tpu.cloudprovider.aws.fake_backend import FileBackedFakeAWSBackend
 from agac_tpu.cluster.rest import RestClusterClient
 from agac_tpu.cluster.testserver import TestApiServer
 
-from .fixtures import make_lb_service
+from agac_tpu import apis
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, make_lb_service
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the kill -9 analog's exit status (AGAC_FAKE_CRASH → os._exit(137))
+CRASH_RC = 137
+
+GC_ARGS = ("--gc-interval", "0.2", "--gc-grace-sweeps", "2", "--gc-max-deletes", "10")
+
+# sub-second leader takeover for the failover drill (production keeps
+# the reference's 60/15/5 defaults)
+FAST_LEASE_ENV = {
+    "AGAC_LEASE_DURATION": "1.5",
+    "AGAC_LEASE_RENEW_DEADLINE": "0.8",
+    "AGAC_LEASE_RETRY_PERIOD": "0.2",
+    # shrink the driver's requeue/poll pacing so cross-controller
+    # convergence (route53 waiting on the accelerator) lands in
+    # seconds, not the production 60 s requeue
+    "AGAC_ACCELERATOR_MISSING_RETRY": "0.1",
+    "AGAC_LB_NOT_ACTIVE_RETRY": "0.1",
+    "AGAC_POLL_INTERVAL": "0.02",
+    "AGAC_POLL_TIMEOUT": "5",
+}
 
 
 def wait_until(pred, timeout=20.0, interval=0.1):
@@ -111,3 +145,273 @@ def _dump(process) -> str:
         out, err = process.communicate(timeout=5)
         return f"controller exited rc={process.returncode}\nstdout:\n{out}\nstderr:\n{err}"
     return "controller still running but condition not met"
+
+
+# ---------------------------------------------------------------------------
+# kill-recovery drills (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+
+class Drill:
+    """One apiserver + one durable fake-AWS state file, across as many
+    controller process generations as a drill needs."""
+
+    def __init__(self, tmp_path, server, zones: str = ""):
+        self.server = server
+        self.state_path = str(tmp_path / "aws-state.json")
+        self.zones = zones
+        kubeconfig = {
+            "current-context": "test",
+            "contexts": [{"name": "test", "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {"server": server.url}}],
+            "users": [{"name": "u", "user": {}}],
+        }
+        self.kubeconfig_path = tmp_path / "kubeconfig"
+        self.kubeconfig_path.write_text(yaml.safe_dump(kubeconfig))
+        self.client = RestClusterClient(server.url)
+        self.processes: list[subprocess.Popen] = []
+
+    def start(
+        self,
+        crash: str = "",
+        args: tuple = (),
+        leader_election: bool = False,
+    ) -> subprocess.Popen:
+        env = dict(
+            os.environ,
+            AGAC_CLOUD="fake",
+            AGAC_FAKE_STATE=self.state_path,
+            AGAC_FAKE_LBS=f"{NLB_NAME}={NLB_HOSTNAME}",
+            POD_NAMESPACE="kube-system",
+            **FAST_LEASE_ENV,
+        )
+        if self.zones:
+            env["AGAC_FAKE_ZONES"] = self.zones
+        if crash:
+            env["AGAC_FAKE_CRASH"] = crash
+        argv = [
+            sys.executable, "-m", "agac_tpu", "-v", "2", "controller",
+            "--kubeconfig", str(self.kubeconfig_path), "-c", "proc-e2e",
+            *args,
+        ]
+        if not leader_election:
+            argv.append("--disable-leader-election")
+        process = subprocess.Popen(
+            argv, cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        self.processes.append(process)
+        return process
+
+    def aws(self) -> FileBackedFakeAWSBackend:
+        """A fresh read-side view of the durable AWS ground truth (its
+        read helpers reload whenever a controller generation wrote)."""
+        return FileBackedFakeAWSBackend(self.state_path)
+
+    def chain(self):
+        """(accelerators, listeners, endpoint_groups) from the durable
+        state — the orphan/convergence probe every drill asserts on."""
+        aws = self.aws()
+        arns = aws.all_accelerator_arns()
+        listeners, groups = [], []
+        for arn in arns:
+            page, _ = aws.list_listeners(arn, 100, None)
+            listeners.extend(page)
+        for listener in listeners:
+            page, _ = aws.list_endpoint_groups(listener.listener_arn, 100, None)
+            groups.extend(page)
+        return arns, listeners, groups
+
+    def chain_complete(self, ports: set = frozenset({80})) -> bool:
+        arns, listeners, groups = self.chain()
+        if not (len(arns) == 1 and len(listeners) == 1 and len(groups) == 1):
+            return False
+        if {p.from_port for p in listeners[0].port_ranges} != set(ports):
+            return False
+        return len(groups[0].endpoint_descriptions) == 1
+
+    def record_names(self, zone_name: str) -> set:
+        aws = self.aws()
+        zone_id = aws.zone_id_by_name(zone_name)
+        if zone_id is None:
+            return set()
+        return {(r.name, r.type) for r in aws.records_in_zone(zone_id)}
+
+    def stop_all(self):
+        for process in self.processes:
+            if process.poll() is None:
+                process.kill()
+                process.wait(5)
+
+    def terminate(self, process) -> int:
+        process.send_signal(signal.SIGTERM)
+        return process.wait(timeout=15)
+
+
+class TestKillRecoveryDrills:
+    def test_kill_mid_create_then_restart_converges(self, tmp_path):
+        """kill -9 between CreateListener and CreateEndpointGroup: the
+        durable state holds a torn chain (accelerator + listener, no
+        endpoint group) and nobody is alive to roll it back.  The next
+        generation's level-triggered ensure repairs it — zero orphans,
+        zero duplicates."""
+        with TestApiServer() as server:
+            drill = Drill(tmp_path, server)
+            try:
+                gen1 = drill.start(crash="create_endpoint_group:before")
+                drill.client.create("Service", make_lb_service(name="drill"))
+                assert gen1.wait(timeout=30) == CRASH_RC, _dump(gen1)
+
+                arns, listeners, groups = drill.chain()
+                assert len(arns) == 1, "accelerator create was committed"
+                assert len(listeners) == 1, "listener create was committed"
+                assert groups == [], "crash fired before the endpoint group"
+
+                gen2 = drill.start()
+                assert wait_until(drill.chain_complete, timeout=30.0), (
+                    f"chain not repaired: {drill.chain()}\n{_dump(gen2)}"
+                )
+                arns, _, _ = drill.chain()
+                assert len(arns) == 1  # repaired, not duplicated
+                assert drill.terminate(gen2) == 0
+            finally:
+                drill.stop_all()
+
+    def test_kill_mid_update_then_restart_converges(self, tmp_path):
+        """kill -9 right before the committed listener update: the
+        Kubernetes spec moved (port 80 → 81) but AWS never heard.  The
+        successor's ensure re-derives the diff and lands it."""
+        with TestApiServer() as server:
+            drill = Drill(tmp_path, server)
+            try:
+                gen1 = drill.start()
+                drill.client.create("Service", make_lb_service(name="drill"))
+                assert wait_until(drill.chain_complete, timeout=30.0), _dump(gen1)
+                assert drill.terminate(gen1) == 0
+
+                svc = drill.client.get("Service", "default", "drill")
+                svc.spec.ports[0].port = 81
+                drill.client.update("Service", svc)
+
+                gen2 = drill.start(crash="update_listener:before")
+                assert gen2.wait(timeout=30) == CRASH_RC, _dump(gen2)
+                assert drill.chain_complete(ports={80}), (
+                    "update must NOT have committed before the crash"
+                )
+
+                gen3 = drill.start()
+                assert wait_until(
+                    lambda: drill.chain_complete(ports={81}), timeout=30.0
+                ), f"update not replayed: {drill.chain()}\n{_dump(gen3)}"
+                assert drill.terminate(gen3) == 0
+            finally:
+                drill.stop_all()
+
+    def test_kill_mid_teardown_sweeper_mops_up(self, tmp_path):
+        """kill -9 mid-teardown AFTER the Service object is gone: the
+        delete event died with the process and the informer relist can
+        never replay it — the exact permanent-leak gap.  Only the GC
+        sweeper can finish the teardown, from ownership tags alone."""
+        with TestApiServer() as server:
+            drill = Drill(tmp_path, server, zones="example.com")
+            try:
+                gen1 = drill.start(crash="delete_listener:before", args=GC_ARGS)
+                drill.client.create(
+                    "Service",
+                    make_lb_service(
+                        name="drill",
+                        annotations={
+                            apis.ROUTE53_HOSTNAME_ANNOTATION: "app.example.com"
+                        },
+                    ),
+                )
+                assert wait_until(
+                    lambda: drill.chain_complete()
+                    and ("app.example.com.", "A") in drill.record_names("example.com"),
+                    timeout=30.0,
+                ), _dump(gen1)
+
+                # teardown: endpoint group deleted, then death before
+                # DeleteListener — a half-torn chain with no owner left
+                drill.client.delete("Service", "default", "drill")
+                assert gen1.wait(timeout=30) == CRASH_RC, _dump(gen1)
+                arns, _, _ = drill.chain()
+                assert len(arns) == 1, "accelerator must still be leaked"
+
+                gen2 = drill.start(args=GC_ARGS)
+                assert wait_until(
+                    lambda: drill.aws().all_accelerator_arns() == []
+                    and not drill.record_names("example.com"),
+                    timeout=30.0,
+                ), (
+                    f"sweeper did not mop up: {drill.chain()}, "
+                    f"records={drill.record_names('example.com')}\n{_dump(gen2)}"
+                )
+                assert drill.terminate(gen2) == 0
+            finally:
+                drill.stop_all()
+
+    def test_leader_failover_standby_converges_and_sweeps(self, tmp_path):
+        """Two real controller processes contend for the lease.  The
+        leader is killed mid-mutation (after committing the disable
+        step of a teardown whose Service is already gone); the standby
+        acquires the lease within one lease duration and its sweeper
+        mops up the orphan — convergence survives leader death."""
+        with TestApiServer() as server:
+            drill = Drill(tmp_path, server, zones="example.com")
+            try:
+                leader = drill.start(
+                    crash="update_accelerator:after-commit",
+                    args=GC_ARGS,
+                    leader_election=True,
+                )
+
+                def lease_holder():
+                    try:
+                        lease = drill.client.get(
+                            "Lease", "kube-system", "aws-global-accelerator-controller"
+                        )
+                    except Exception:
+                        return None
+                    return lease.spec.holder_identity or None
+
+                assert wait_until(lambda: lease_holder() is not None), _dump(leader)
+                first_holder = lease_holder()
+
+                standby = drill.start(args=GC_ARGS, leader_election=True)
+
+                drill.client.create(
+                    "Service",
+                    make_lb_service(
+                        name="drill",
+                        annotations={
+                            apis.ROUTE53_HOSTNAME_ANNOTATION: "app.example.com"
+                        },
+                    ),
+                )
+                assert wait_until(drill.chain_complete, timeout=30.0), _dump(leader)
+
+                # the mutation the leader dies inside: teardown's
+                # disable step commits, then the process is gone
+                drill.client.delete("Service", "default", "drill")
+                assert leader.wait(timeout=30) == CRASH_RC, _dump(leader)
+                assert len(drill.aws().all_accelerator_arns()) == 1
+
+                # standby takes the lease and converges: the sweeper
+                # (not a delete event — that died with the leader)
+                # finishes the teardown
+                assert wait_until(
+                    lambda: lease_holder() not in (None, first_holder),
+                    timeout=15.0,
+                ), _dump(standby)
+                assert wait_until(
+                    lambda: drill.aws().all_accelerator_arns() == []
+                    and not drill.record_names("example.com"),
+                    timeout=30.0,
+                ), (
+                    f"standby did not mop up: {drill.chain()}, "
+                    f"records={drill.record_names('example.com')}\n{_dump(standby)}"
+                )
+                assert drill.terminate(standby) == 0
+            finally:
+                drill.stop_all()
