@@ -73,6 +73,17 @@ e2e-kind-smoke:
 lint-invariants:
 	$(PYTHON) -m agac_tpu.analysis.lint agac_tpu tests bench.py
 
+# Regenerate the metric catalog table in docs/operations.md from the
+# live registry (agac_tpu/observability/instruments.py declares every
+# metric); check-metrics-catalog is the CI drift gate.
+.PHONY: metrics-catalog
+metrics-catalog:
+	$(PYTHON) -m agac_tpu.observability.catalog docs/operations.md
+
+.PHONY: check-metrics-catalog
+check-metrics-catalog:
+	$(PYTHON) -m agac_tpu.observability.catalog --check docs/operations.md
+
 .PHONY: bench
 bench:
 	$(PYTHON) bench.py

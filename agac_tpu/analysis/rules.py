@@ -34,6 +34,12 @@ stack — the classes ruff's pyflakes-tier cannot express:
   the health plane (ISSUE 3): an unbounded poll against a wedged
   backend holds its worker forever with no signal — exactly the
   180 s-settle-poll wedge the reconcile deadline exists to cut.
+- ``unregistered-metric`` — Counter/Gauge/Histogram primitives must be
+  built through the shared observability registry with literal names
+  and label tuples (ISSUE 5): a directly constructed metric silently
+  never reaches ``/metrics`` (the exact private-counter drift the
+  observability plane deletes), and a computed label set is how a
+  key/error-text cardinality explosion melts the scrape.
 - ``delete-without-ownership-check`` — teardown calls reachable from
   the GC sweeper (``controllers/garbagecollector.py``) must flow
   through an ownership-verification helper (ISSUE 4): the sweeper is
@@ -578,6 +584,137 @@ def check_delete_without_ownership_check(
                 "ownership-verification helper in the same function — "
                 "route the deletion through "
                 "verify_*_orphan_ownership(...) first",
+            )
+
+
+# ---------------------------------------------------------------------------
+# unregistered-metric
+# ---------------------------------------------------------------------------
+
+# the metric primitive class names exported by the observability
+# registry module — constructing one directly bypasses registration
+# (the series silently never reaches /metrics) and skips the
+# registry's label-cardinality cap
+_METRIC_CLASSES = frozenset({"Counter", "Gauge", "Histogram", "Metric"})
+# the registry's factory method names; calls to these are the
+# sanctioned construction path, but their name/label arguments must be
+# literals — a dynamic label tuple is exactly how unbounded
+# cardinality (keys, error text) sneaks into a metric
+_REGISTRY_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+
+def _observability_metric_names(tree: ast.Module) -> set[str]:
+    """Local names bound to the observability metric classes (or the
+    metrics module itself), from this module's imports.  Tracking the
+    import provenance keeps ``collections.Counter`` and every other
+    unrelated Counter out of scope."""
+    class_names: set[str] = set()
+    module_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            from_metrics = module.endswith("observability.metrics") or (
+                node.level > 0 and module.split(".")[-1] == "metrics"
+            )
+            from_observability = module.endswith("observability") or (
+                node.level > 0 and module.split(".")[-1] == "observability"
+            )
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if from_metrics and alias.name in _METRIC_CLASSES:
+                    class_names.add(bound)
+                elif from_metrics and alias.name == "*":
+                    class_names.update(_METRIC_CLASSES)
+                elif from_observability and alias.name == "metrics":
+                    module_names.add(bound)
+                elif from_observability and alias.name in _METRIC_CLASSES:
+                    class_names.add(bound)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith("observability.metrics"):
+                    module_names.add(alias.asname or alias.name.split(".")[-1])
+    # attribute access through the module (metrics.Counter) counts too
+    return class_names | {f"{m}.{c}" for m in module_names for c in _METRIC_CLASSES}
+
+
+def _is_metrics_module(ctx: LintContext) -> bool:
+    return "observability" in ctx.path.parts and ctx.path.name == "metrics.py"
+
+
+def _literal_str(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant) and isinstance(node.value, str)
+
+
+def _literal_str_sequence(node: ast.expr) -> bool:
+    return isinstance(node, (ast.Tuple, ast.List)) and all(
+        _literal_str(elt) for elt in node.elts
+    )
+
+
+@rule(
+    "unregistered-metric",
+    "Counter/Gauge/Histogram must be built through the shared registry "
+    "(registry.counter(...)) with literal names and label tuples — a direct "
+    "construction never reaches /metrics, and dynamic label names are an "
+    "unbounded-cardinality risk",
+)
+def check_unregistered_metric(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
+    if _is_metrics_module(ctx):
+        return  # the registry module is where the primitives live
+    metric_names = _observability_metric_names(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        # direct construction: Counter(...) / metrics.Counter(...)
+        called = None
+        if isinstance(func, ast.Name) and func.id in metric_names:
+            called = func.id
+        elif (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and f"{func.value.id}.{func.attr}" in metric_names
+        ):
+            called = f"{func.value.id}.{func.attr}"
+        if called is not None:
+            yield Violation(
+                "unregistered-metric",
+                str(ctx.path),
+                node.lineno,
+                f"direct {called}(...) construction bypasses the registry — "
+                "use registry.counter/gauge/histogram(...) so the series is "
+                "exported and cardinality-capped",
+            )
+            continue
+        # registry factory call: name + labels must be literal
+        if not (isinstance(func, ast.Attribute) and func.attr in _REGISTRY_FACTORIES):
+            continue
+        if not node.args and not any(k.arg == "name" for k in node.keywords):
+            continue  # not a metric declaration shape (e.g. itertools.count)
+        name_arg = node.args[0] if node.args else next(
+            (k.value for k in node.keywords if k.arg == "name"), None
+        )
+        if name_arg is not None and not _literal_str(name_arg):
+            yield Violation(
+                "unregistered-metric",
+                str(ctx.path),
+                node.lineno,
+                f".{func.attr}(...) with a non-literal metric name — computed "
+                "names make the exported series set unreviewable",
+            )
+        labels_arg = next(
+            (k.value for k in node.keywords if k.arg == "labels"),
+            node.args[2] if len(node.args) > 2 else None,
+        )
+        if labels_arg is not None and not _literal_str_sequence(labels_arg):
+            yield Violation(
+                "unregistered-metric",
+                str(ctx.path),
+                node.lineno,
+                f".{func.attr}(...) with non-literal label names — label "
+                "NAMES must be a fixed literal tuple (values vary, names "
+                "never do); a dynamic label set is an unbounded-cardinality "
+                "risk",
             )
 
 

@@ -36,6 +36,8 @@ from __future__ import annotations
 import os
 import threading
 
+from ...observability import instruments as obs_instruments
+from ...observability import metrics as obs_metrics
 from .cache import (
     AcceleratorTopologyCache,
     DiscoveryCache,
@@ -137,7 +139,8 @@ def shared_health_tracker() -> HealthTracker | None:
     with _lock:
         if _health_tracker is None:
             _health_tracker = HealthTracker(
-                HealthConfig(
+                registry=obs_metrics.registry(),
+                config=HealthConfig(
                     window=window,
                     min_calls=int(_env_float("AGAC_API_HEALTH_MIN_CALLS", 10)),
                     failure_ratio=_env_float("AGAC_API_HEALTH_FAILURE_RATIO", 0.5),
@@ -398,6 +401,12 @@ def real_cloud_factory(region: str) -> AWSDriver:
         record_cache=_shared_record_cache(),
         lb_coalescer=_shared_lb_coalescer(region),
         **_driver_timing(),
+    )
+    # expose every live cache's hit/miss counters as collection-time
+    # gauges on the global registry (ISSUE 5) — the caches keep their
+    # own counters, /metrics reads them through read_plane_stats
+    obs_instruments.read_plane_instruments(obs_metrics.registry()).watch_stats(
+        read_plane_stats
     )
     if os.environ.get("AGAC_CLOUD") == "fake":
         backend = shared_fake_backend()

@@ -45,6 +45,8 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ... import klog
+from ...observability import instruments
+from ...observability.metrics import MetricsRegistry
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
 from .errors import AWSAPIError
 
@@ -408,6 +410,7 @@ class ServiceHealth:
         config: HealthConfig,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.name = name
         self._config = config
@@ -431,13 +434,25 @@ class ServiceHealth:
             if config.aimd_qps > 0
             else None
         )
-        self._lock = threading.Lock()
-        self._counters = {
-            OUTCOME_SUCCESS: 0,
-            OUTCOME_THROTTLE: 0,
-            OUTCOME_SERVER_ERROR: 0,
-            OUTCOME_CONNECTION_ERROR: 0,
+        # outcome counters and circuit/AIMD views live in the metrics
+        # registry (ISSUE 5) — the registry children ARE the counters,
+        # so /metrics, snapshot() and bench_detail read one source
+        # instead of a privately maintained dict.  ``registry=None``
+        # keeps a private registry (test isolation); the factory wires
+        # the process-global one.
+        metrics = instruments.health_instruments(
+            registry if registry is not None else MetricsRegistry()
+        )
+        self._outcome_counters = {
+            outcome: metrics.outcomes.labels(service=name, outcome=outcome)
+            for outcome in (
+                OUTCOME_SUCCESS,
+                OUTCOME_THROTTLE,
+                OUTCOME_SERVER_ERROR,
+                OUTCOME_CONNECTION_ERROR,
+            )
         }
+        metrics.watch_service(self)
 
     def is_open(self) -> bool:
         return self.breaker.state() != STATE_CLOSED
@@ -464,8 +479,9 @@ class ServiceHealth:
     def record(self, outcome: Optional[str]) -> None:
         if outcome is None:
             return
-        with self._lock:
-            self._counters[outcome] = self._counters.get(outcome, 0) + 1
+        counter = self._outcome_counters.get(outcome)
+        if counter is not None:
+            counter.inc()
         self.breaker.record(outcome in _FAILURE_OUTCOMES)
         if self.limiter is not None:
             if outcome == OUTCOME_THROTTLE:
@@ -477,8 +493,12 @@ class ServiceHealth:
         self.record(classify_error(err))
 
     def snapshot(self) -> dict:
-        with self._lock:
-            counters = dict(self._counters)
+        # rendered FROM the registry children — /healthz, /readyz and
+        # /metrics can never disagree about these counts
+        counters = {
+            outcome: int(counter.value())
+            for outcome, counter in self._outcome_counters.items()
+        }
         snap = {"circuit": self.breaker.snapshot(), "outcomes": counters}
         if self.limiter is not None:
             snap["aimd_rate"] = round(self.limiter.rate(), 3)
@@ -541,10 +561,15 @@ class HealthTracker:
         config: Optional[HealthConfig] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.config = config or HealthConfig()
         self._clock = clock
         self._sleep = sleep
+        # one registry for every service's counters/gauges; private by
+        # default (tests build many trackers per process), the factory
+        # passes the process-global registry so /metrics carries them
+        self.registry = registry if registry is not None else MetricsRegistry()
         self._lock = threading.Lock()
         self._services: dict[str, ServiceHealth] = {}
 
@@ -553,7 +578,8 @@ class HealthTracker:
             health = self._services.get(name)
             if health is None:
                 health = self._services[name] = ServiceHealth(
-                    name, self.config, clock=self._clock, sleep=self._sleep
+                    name, self.config, clock=self._clock, sleep=self._sleep,
+                    registry=self.registry,
                 )
             return health
 

@@ -32,6 +32,7 @@ from typing import Any, Callable, Optional
 from .. import klog
 from ..analysis import racecheck
 from ..errors import NotFoundError
+from ..observability import instruments
 from .client import ClusterClient
 from .objects import meta_namespace_key
 
@@ -69,6 +70,23 @@ class SharedInformer:
         # handlers never run concurrently for the same informer
         self._deltas: queue_mod.Queue = queue_mod.Queue()
         self._started = False
+        # observability (ISSUE 5): resync lag + store size as
+        # collection-time views, list/watch failures as a counter.
+        # -1 until the first successful relist — "never synced" must
+        # not read as "freshly synced".
+        self._last_relist = -1.0
+        informer_metrics = instruments.informer_instruments()
+        informer_metrics.resync_age.labels(kind=kind).set_function(
+            lambda: (
+                -1.0
+                if self._last_relist < 0
+                else max(0.0, time.monotonic() - self._last_relist)
+            )
+        )
+        informer_metrics.items.labels(kind=kind).set_function(
+            lambda: len(self._store)
+        )
+        self._m_listwatch_errors = informer_metrics.listwatch_errors.labels(kind=kind)
 
     # ---- registration --------------------------------------------------
     def add_event_handler(
@@ -133,6 +151,7 @@ class SharedInformer:
                 for event in self._client.watch(self.kind, rv, should_stop):
                     self._apply(event.type, event.obj)
             except Exception as err:
+                self._m_listwatch_errors.inc()
                 klog.errorf("informer %s: list/watch failed: %s", self.kind, err)
                 stop.wait(1.0)
 
@@ -153,6 +172,7 @@ class SharedInformer:
             for key, obj in old.items():
                 if key not in fresh:
                     self._deltas.put(("delete", None, Tombstone(key, obj), handlers))
+        self._last_relist = time.monotonic()
         return rv
 
     def _apply(self, event_type: str, obj: Any) -> None:

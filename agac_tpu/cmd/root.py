@@ -149,6 +149,19 @@ def build_parser() -> argparse.ArgumentParser:
         "counters on /healthz).",
     )
     controller.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="Serve the Prometheus /metrics exposition on a dedicated "
+        "port in addition to the health server (which always carries "
+        "/metrics). 0 (default) disables the dedicated listener.",
+    )
+    controller.add_argument(
+        "--trace-sample", type=float, default=0.0,
+        help="Fraction of reconciles to trace (0..1): a sampled item "
+        "emits one structured JSON log line with queue-wait, sync, "
+        "per-AWS-call and settle-poll spans plus the requeue decision. "
+        "0 (default) disables tracing.",
+    )
+    controller.add_argument(
         "--read-plane-ttl", type=float, default=None,
         help="Tick scope (seconds) of the coalesced verification read "
         "plane: accelerator-topology, record-set and load-balancer "
@@ -264,19 +277,32 @@ def run_controller(args) -> int:
         probe_budget=args.api_health_probe_budget,
         aimd_qps=args.api_health_aimd_qps,
     )
+    from ..observability import metrics as obs_metrics
+    from ..observability import trace as obs_trace
+
+    obs_trace.configure(args.trace_sample)
     tracker = shared_health_tracker()
-    manager = Manager(health=tracker)
+    manager = Manager(health=tracker, metrics_registry=obs_metrics.registry())
+
+    import threading
+
+    from ..manager import make_health_server
 
     if args.health_port > 0:
-        from ..manager import make_health_server
-
         health_server = make_health_server(
             args.health_port, health=tracker, gc_status=manager.gc_status
         )
-        import threading
-
         threading.Thread(
             target=health_server.serve_forever, daemon=True, name="health-server"
+        ).start()
+    if args.metrics_port > 0 and args.metrics_port != args.health_port:
+        # a dedicated scrape listener for deployments that separate
+        # probe and metrics networks; same handler, same registry
+        metrics_server = make_health_server(
+            args.metrics_port, health=tracker, gc_status=manager.gc_status
+        )
+        threading.Thread(
+            target=metrics_server.serve_forever, daemon=True, name="metrics-server"
         ).start()
 
     def run_manager(stop_event):

@@ -54,6 +54,8 @@ from typing import Callable, Optional
 from .. import klog
 from ..cloudprovider.aws.driver import OWNER_TAG_KEY, accelerator_owner_tag_value
 from ..errors import NotFoundError
+from ..observability import instruments, recorder
+from ..observability.metrics import MetricsRegistry
 from .common import CloudFactory, GLOBAL_REGION
 
 CONTROLLER_AGENT_NAME = "garbage-collector"
@@ -120,6 +122,7 @@ class GarbageCollector:
         config: GarbageCollectorConfig,
         cloud_factory: CloudFactory,
         health=None,
+        registry: "MetricsRegistry | None" = None,
     ):
         self._config = config
         self._cloud = cloud_factory
@@ -132,9 +135,33 @@ class GarbageCollector:
         # grace state: candidate -> consecutive sweeps observed orphaned
         self._pending_accelerators: dict[str, int] = {}  # arn -> count
         self._pending_records: dict[tuple[str, str, str], int] = {}
-        self.sweeps_total = 0
-        self.deleted_total = 0
-        self.adopted_total = 0
+        # cumulative totals live in the metrics registry (ISSUE 5) —
+        # status(), /healthz and /metrics all read the same children
+        # instead of separately maintained ints.  registry=None keeps
+        # a private registry (unit-tier isolation); the manager passes
+        # its own (the process-global one in production).
+        metrics = instruments.gc_instruments(
+            registry if registry is not None else MetricsRegistry()
+        )
+        self._m_sweeps = metrics.sweeps
+        self._m_deleted = {
+            "accelerators": metrics.deleted.labels(kind="accelerators"),
+            "records": metrics.deleted.labels(kind="records"),
+        }
+        self._m_adopted = metrics.adopted
+        self._m_would_delete = metrics.would_delete
+        self._m_pending = {
+            "accelerators": metrics.pending.labels(kind="accelerators"),
+            "records": metrics.pending.labels(kind="records"),
+        }
+        self._m_candidates = {
+            "accelerators": metrics.last_candidates.labels(kind="accelerators"),
+            "records": metrics.last_candidates.labels(kind="records"),
+        }
+        self._m_pending["accelerators"].set_function(
+            lambda: len(self._pending_accelerators)
+        )
+        self._m_pending["records"].set_function(lambda: len(self._pending_records))
         self.last_sweep_report: dict = {}
 
     # ------------------------------------------------------------------
@@ -193,9 +220,8 @@ class GarbageCollector:
             "skipped_unsynced": False,
             "listing_failed": [],
         }
-        with self._lock:
-            self.sweeps_total += 1
-            report["sweep"] = self.sweeps_total
+        self._m_sweeps.inc()
+        report["sweep"] = int(self._m_sweeps.value())
         if not self._synced():
             # an informer that has not listed yet makes EVERY owner
             # look absent — the one mistake this controller must never
@@ -221,11 +247,20 @@ class GarbageCollector:
         return report
 
     def _store_report(self, report: dict) -> None:
+        for kind in ("accelerators", "records"):
+            self._m_deleted[kind].inc(report["deleted"][kind])
+            self._m_candidates[kind].set(report["candidates"][kind])
+        self._m_adopted.inc(report["adopted"])
+        self._m_would_delete.inc(report["would_delete"])
+        recorder.flight_recorder().record(
+            "gc-sweep",
+            sweep=report.get("sweep"),
+            deleted=dict(report["deleted"]),
+            candidates=dict(report["candidates"]),
+            adopted=report["adopted"],
+            dry_run=report["dry_run"],
+        )
         with self._lock:
-            self.deleted_total += (
-                report["deleted"]["accelerators"] + report["deleted"]["records"]
-            )
-            self.adopted_total += report["adopted"]
             self.last_sweep_report = report
 
     def _sweep_accelerators(self, cloud, report: dict, budget: list) -> None:
@@ -394,20 +429,24 @@ class GarbageCollector:
     def status(self) -> dict:
         """The /healthz + bench payload: config, cumulative totals,
         pending (grace-held) queue depths, and the last sweep's full
-        counter set."""
+        counter set.  Totals are read FROM the registry children (the
+        single source /metrics also renders)."""
         with self._lock:
-            return {
-                "enabled": True,
-                "dry_run": self._config.dry_run,
-                "interval": self._config.interval,
-                "grace_sweeps": self._config.grace_sweeps,
-                "max_deletes": self._config.max_deletes,
-                "sweeps_total": self.sweeps_total,
-                "deleted_total": self.deleted_total,
-                "adopted_total": self.adopted_total,
-                "pending": {
-                    "accelerators": len(self._pending_accelerators),
-                    "records": len(self._pending_records),
-                },
-                "last_sweep": dict(self.last_sweep_report),
-            }
+            last_sweep = dict(self.last_sweep_report)
+        return {
+            "enabled": True,
+            "dry_run": self._config.dry_run,
+            "interval": self._config.interval,
+            "grace_sweeps": self._config.grace_sweeps,
+            "max_deletes": self._config.max_deletes,
+            "sweeps_total": int(self._m_sweeps.value()),
+            "deleted_total": int(
+                sum(child.value() for child in self._m_deleted.values())
+            ),
+            "adopted_total": int(self._m_adopted.value()),
+            "pending": {
+                "accelerators": int(self._m_pending["accelerators"].value()),
+                "records": int(self._m_pending["records"].value()),
+            },
+            "last_sweep": last_sweep,
+        }

@@ -40,6 +40,7 @@ from .analysis import racecheck
 from .cluster import ClusterClient, Lease
 from .cluster.objects import LeaseSpec, ObjectMeta
 from .errors import AlreadyExistsError, ConflictError, NotFoundError
+from .observability import instruments
 
 
 def _now_rfc3339() -> str:
@@ -78,6 +79,14 @@ class LeaderElection:
         self._observed_lock = racecheck.make_lock(f"leaderelection.{name}")
         self._observed_record: Optional[tuple] = None
         self._observed_time: float = 0.0
+        # observability (ISSUE 5): the held gauge is a live view over
+        # the leading event; takeovers count when this elector bumps
+        # lease_transitions
+        election_metrics = instruments.leaderelection_instruments()
+        election_metrics.is_leader.labels(name=name).set_function(
+            lambda: 1.0 if self._leading.is_set() else 0.0
+        )
+        self._m_transitions = election_metrics.transitions.labels(name=name)
 
     def is_leader(self) -> bool:
         return self._leading.is_set()
@@ -187,6 +196,7 @@ class LeaderElection:
             observed_time = self._observed_time
 
         holder = lease.spec.holder_identity or ""
+        took_over = False
         if holder != self.identity:
             if holder:
                 # Freshness on the LOCAL monotonic clock only: the lease
@@ -201,11 +211,14 @@ class LeaderElection:
                     return False, holder  # lease is held and fresh
             lease.spec.lease_transitions += 1
             lease.spec.acquire_time = now
+            took_over = True
         lease.spec.holder_identity = self.identity
         lease.spec.renew_time = now
         lease.spec.lease_duration_seconds = int(self.config.lease_duration)
         try:
             client.update("Lease", lease)
+            if took_over:
+                self._m_transitions.inc()
             return True, self.identity
         except (ConflictError, NotFoundError):
             return False, holder

@@ -40,6 +40,8 @@ from typing import Callable, Optional
 from . import klog
 from .cloudprovider.aws import health as api_health
 from .cluster import ClusterClient, SharedInformerFactory
+from .observability import metrics as obs_metrics
+from .observability import recorder as obs_recorder
 from .controllers import (
     EndpointGroupBindingConfig,
     EndpointGroupBindingController,
@@ -102,10 +104,20 @@ class Manager:
         resync_period: float = INFORMER_RESYNC_PERIOD,
         health: Optional["api_health.HealthTracker"] = None,
         heartbeats: Optional["api_health.WorkerHeartbeats"] = None,
+        metrics_registry: Optional["obs_metrics.MetricsRegistry"] = None,
     ):
         self._resync_period = resync_period
         self._health = health
         self.heartbeats = heartbeats or api_health.worker_heartbeats()
+        # the registry the GC sweeper's counters land in (ISSUE 5);
+        # None keeps a private one per manager (unit tiers build many
+        # managers per process), cmd/root and the bench pass the
+        # process-global registry so /metrics carries the gc series
+        self.metrics_registry = (
+            metrics_registry
+            if metrics_registry is not None
+            else obs_metrics.MetricsRegistry()
+        )
         self.controllers: dict[str, object] = {}
         # what the last drift_tick did, for bench_detail.json and tests:
         # {"enqueued": {controller: n}, "skipped": {controller: [svc]},
@@ -146,7 +158,8 @@ class Manager:
             # do) and the same cloud factory (deletes flow through the
             # shaped drivers); it never sweeps before those caches sync
             self.gc = GarbageCollector(
-                informer_factory, gc_config, cloud_factory, health=self._health
+                informer_factory, gc_config, cloud_factory, health=self._health,
+                registry=self.metrics_registry,
             )
             threading.Thread(
                 target=self.gc.run, args=(stop,), daemon=True,
@@ -225,6 +238,12 @@ class Manager:
             report["enqueued"][name] = count
             enqueued += count
         self.last_drift_report = report
+        obs_recorder.flight_recorder().record(
+            "drift-tick",
+            enqueued=dict(report["enqueued"]),
+            skipped=dict(report["skipped"]),
+            partial=report["partial"],
+        )
         return enqueued
 
     def gc_sweep(self) -> dict:
@@ -264,6 +283,12 @@ class _HealthHandler(BaseHTTPRequestHandler):
         if self.path == "/readyz":
             self._readyz()
             return
+        if self.path == "/metrics":
+            self._metrics()
+            return
+        if self.path == "/debug/flightrecorder":
+            self._flightrecorder()
+            return
         self.send_error(404)
 
     def _healthz(self):
@@ -299,6 +324,29 @@ class _HealthHandler(BaseHTTPRequestHandler):
         }
         self._respond(503 if open_services else 200, body)
 
+    def _metrics(self):
+        """Prometheus text exposition of the wired registry (ISSUE 5):
+        the scrape endpoint operators point their Prometheus at."""
+        payload = self.server.metrics_registry.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", obs_metrics.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _flightrecorder(self):
+        """The flight recorder's ring buffer, oldest → newest — the
+        live post-mortem of the last few hundred reconcile outcomes."""
+        recorder = self.server.flight_recorder
+        self._respond(
+            200,
+            {
+                "capacity": recorder.capacity,
+                "recorded_total": recorder.recorded_total,
+                "entries": recorder.dump(),
+            },
+        )
+
     def _respond(self, code: int, body: dict):
         payload = json.dumps(body).encode()
         self.send_response(code)
@@ -315,14 +363,28 @@ def make_health_server(
     stuck_threshold: float = WORKER_STUCK_THRESHOLD,
     host: str = "",
     gc_status: Optional[Callable[[], dict]] = None,
+    metrics_registry: Optional["obs_metrics.MetricsRegistry"] = None,
+    flight_recorder: Optional["obs_recorder.FlightRecorder"] = None,
 ) -> ThreadingHTTPServer:
     """Build the manager's health endpoint (bind port 0 in tests);
     call ``serve_forever`` on a daemon thread to serve.  ``gc_status``
-    is the manager's ``gc_status`` hook (defaults to disabled)."""
+    is the manager's ``gc_status`` hook (defaults to disabled).
+    ``/metrics`` renders ``metrics_registry`` (default: the
+    process-global registry, where the hot-path instruments land) and
+    ``/debug/flightrecorder`` dumps ``flight_recorder`` (default: the
+    process-global ring)."""
     server = ThreadingHTTPServer((host, port), _HealthHandler)
     server.health_tracker = health
     server.heartbeats = heartbeats or api_health.worker_heartbeats()
     server.stuck_threshold = stuck_threshold
     server.gc_status = gc_status or (lambda: {"enabled": False})
+    server.metrics_registry = (
+        metrics_registry if metrics_registry is not None else obs_metrics.registry()
+    )
+    server.flight_recorder = (
+        flight_recorder
+        if flight_recorder is not None
+        else obs_recorder.flight_recorder()
+    )
     klog.infof("Health endpoint listening on :%d", server.server_address[1])
     return server

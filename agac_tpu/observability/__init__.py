@@ -1,0 +1,40 @@
+"""The observability plane (ISSUE 5): metrics, trace spans, and the
+flight recorder.
+
+Three dependency-free modules give the whole stack one telemetry
+surface (Arcturus' stability argument applied to *this* control plane:
+you cannot operate what you cannot measure):
+
+- ``metrics``: a thread-safe Prometheus-style registry
+  (Counter/Gauge/Histogram with bounded label cardinality and text
+  exposition) — every subsystem's counters live here instead of in
+  private dicts;
+- ``trace``: sampled per-reconcile trace spans (queue wait, sync,
+  each AWS call, settle polls, the requeue decision) emitted as
+  structured log lines;
+- ``recorder``: a fixed-size ring buffer of recent reconcile
+  outcomes/errors, dumpable via ``/debug/flightrecorder`` and on
+  SIGTERM — the post-mortem the logs have usually rotated away.
+
+``instruments`` centralizes every metric declaration so the exposed
+catalog (``python -m agac_tpu.observability.catalog``) can never drift
+from the instrumented code.
+"""
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
+from .recorder import FlightRecorder, flight_recorder
+from .trace import Span, Trace, Tracer, tracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "FlightRecorder",
+    "flight_recorder",
+    "Span",
+    "Trace",
+    "Tracer",
+    "tracer",
+]

@@ -24,14 +24,25 @@ from ``key_to_obj`` selects the delete path, mirroring apimachinery's
 from __future__ import annotations
 
 import copy
+import threading
 import time
 from typing import Any, Callable
 
 from .. import klog
 from ..cloudprovider.aws import health as api_health
 from ..errors import NoRetryError, NotFoundError, is_no_retry
+from ..observability import instruments, recorder, trace
 from .result import Result
 from .workqueue import RateLimitingQueue
+
+
+def _controller_name() -> str:
+    """The controller a worker thread belongs to, from the
+    ``{controller}-worker-{i}`` naming ``run_workers`` applies — the
+    per-controller label the reconcile metrics and traces share.
+    Non-pool threads (tests, direct drivers) label as themselves."""
+    name = threading.current_thread().name
+    return name.rsplit("-worker-", 1)[0]
 
 KeyToObjFunc = Callable[[str], Any]
 ProcessDeleteFunc = Callable[[str], Result]
@@ -109,14 +120,30 @@ def process_next_work_item(
     heartbeats.begin(item if isinstance(item, str) else repr(item))
     if reconcile_deadline:
         api_health.set_reconcile_deadline(reconcile_deadline)
+    # observability plane (ISSUE 5): a sampled item gets a trace whose
+    # spans (queue wait here; AWS calls and settle polls via the
+    # driver hooks) ride a thread-local — unsampled items carry None
+    # and every tracing call site degrades to a no-op
+    tracer = trace.tracer()
+    item_trace = tracer.start(
+        _controller_name(),
+        item if isinstance(item, str) else repr(item),
+        queue_wait=getattr(queue, "last_pop_wait", lambda: None)(),
+    )
     try:
-        _reconcile_handler(
-            item, queue, key_to_obj, process_delete, process_create_or_update,
-            on_sync_result,
-        )
+        with trace.activate(item_trace):
+            _reconcile_handler(
+                item, queue, key_to_obj, process_delete, process_create_or_update,
+                on_sync_result,
+            )
     except Exception as err:  # containment: a bad item must not kill the worker
         klog.errorf("unhandled error reconciling %r: %s", item, err)
     finally:
+        tracer.finish(item_trace)
+        if item_trace is not None:
+            instruments.reconcile_instruments().traces_sampled.labels(
+                controller=item_trace.controller
+            ).inc()
         api_health.clear_reconcile_deadline()
         heartbeats.done()
         queue.done(item)
@@ -137,34 +164,63 @@ def _reconcile_handler(
         return
     start = time.monotonic()
     try:
-        res, err = _dispatch(key, key_to_obj, process_delete, process_create_or_update)
+        with trace.span("sync"):
+            res, err = _dispatch(
+                key, key_to_obj, process_delete, process_create_or_update
+            )
     finally:
         elapsed = time.monotonic() - start
         klog.v(4).infof("Finished syncing %r (%.3fs)", key, elapsed)
     if _sync_duration_observers:
         _observe_sync_duration(key, elapsed, err)
 
+    controller = _controller_name()
+    reconcile_metrics = instruments.reconcile_instruments()
+    reconcile_metrics.duration.labels(controller=controller).observe(elapsed)
+
     if err is not None:
         permanent = is_no_retry(err)
         if permanent:
+            result = instruments.RESULT_PERMANENT_ERROR
             klog.errorf("error syncing %r: %s", key, err)
         else:
+            result = instruments.RESULT_ERROR
             queue.add_rate_limited(key)
             klog.errorf("error syncing %r, and requeued: %s", key, err)
+        if isinstance(err, api_health.DeadlineExceeded):
+            reconcile_metrics.deadline_exceeded.labels(controller=controller).inc()
         _notify(on_sync_result, key, err, queue.num_requeues(key), permanent)
     elif res.requeue_after > 0:
+        result = instruments.RESULT_REQUEUE_AFTER
         queue.forget(key)
         queue.add_after(key, res.requeue_after)
         klog.infof("Successfully synced %r, but requeued after %.1fs", key, res.requeue_after)
         _notify(on_sync_result, key, None, 0, False)
     elif res.requeue:
+        result = instruments.RESULT_REQUEUE
         queue.add_rate_limited(key)
         klog.infof("Successfully synced %r, but requeued", key)
         _notify(on_sync_result, key, None, 0, False)
     else:
+        result = instruments.RESULT_SUCCESS
         queue.forget(key)
         klog.infof("Successfully synced %r", key)
         _notify(on_sync_result, key, None, 0, False)
+
+    reconcile_metrics.results.labels(controller=controller, result=result).inc()
+    active_trace = trace.current()
+    if active_trace is not None:
+        active_trace.annotate(
+            result=result, error=str(err) if err is not None else None
+        )
+    recorder.flight_recorder().record(
+        "reconcile",
+        controller=controller,
+        key=key,
+        result=result,
+        duration=round(elapsed, 4),
+        error=str(err) if err is not None else "",
+    )
 
 
 def _notify(hook, key, err, requeues, permanent) -> None:

@@ -27,6 +27,7 @@ from collections import deque
 from typing import Any, Callable, Hashable, Optional
 
 from ..analysis import racecheck
+from ..observability import instruments
 
 
 class ItemExponentialFailureRateLimiter:
@@ -181,10 +182,23 @@ class RateLimitingQueue:
         rate_limiter=None,
         name: str = "",
         clock: Callable[[], float] = time.monotonic,
+        metrics_registry=None,
     ):
         self.name = name
         self._clock = clock
         self._limiter = rate_limiter or default_controller_rate_limiter()
+        # the controller-runtime standard workqueue metric set, bound
+        # to this queue's name label (observability plane, ISSUE 5)
+        queue_metrics = instruments.workqueue_instruments(metrics_registry)
+        label = name or "unnamed"
+        self._m_depth = queue_metrics.depth.labels(name=label)
+        self._m_adds = queue_metrics.adds.labels(name=label)
+        self._m_retries = queue_metrics.retries.labels(name=label)
+        self._m_queue_duration = queue_metrics.queue_duration.labels(name=label)
+        self._m_work_duration = queue_metrics.work_duration.labels(name=label)
+        self._added_at: dict[Hashable, float] = {}  # item -> enqueue time
+        self._got_at: dict[Hashable, float] = {}  # item -> handed-out time
+        self._pop_wait = threading.local()  # per-worker last queue wait
         # racecheck seam: a plain Lock unless the lock-order watchdog
         # is enabled (tests), in which case acquisition order across
         # the worker/waker/handler threads is recorded and verified
@@ -208,9 +222,12 @@ class RateLimitingQueue:
         if self._shutting_down or item in self._dirty:
             return
         self._dirty.add(item)
+        self._m_adds.inc()
+        self._added_at[item] = self._clock()
         if item in self._processing:
             return
         self._queue.append(item)
+        self._m_depth.set(len(self._queue))
         self._ready.notify()
 
     def add(self, item: Hashable) -> None:
@@ -240,13 +257,30 @@ class RateLimitingQueue:
             item = self._queue.popleft()
             self._processing.add(item)
             self._dirty.discard(item)
+            now = self._clock()
+            wait = max(0.0, now - self._added_at.pop(item, now))
+            self._m_queue_duration.observe(wait)
+            self._pop_wait.wait = wait
+            self._got_at[item] = now
+            self._m_depth.set(len(self._queue))
             return item, False
+
+    def last_pop_wait(self) -> Optional[float]:
+        """The queued-time of the item THIS worker thread most
+        recently got — the queue-wait span the reconcile trace
+        attaches (the add timestamp is known only to the queue)."""
+        return getattr(self._pop_wait, "wait", None)
 
     def done(self, item: Hashable) -> None:
         with self._mutex:
             self._processing.discard(item)
+            now = self._clock()
+            started = self._got_at.pop(item, None)
+            if started is not None:
+                self._m_work_duration.observe(max(0.0, now - started))
             if item in self._dirty:
                 self._queue.append(item)
+                self._m_depth.set(len(self._queue))
                 self._ready.notify()
 
     def __len__(self) -> int:
@@ -294,6 +328,7 @@ class RateLimitingQueue:
 
     # ---- RateLimitingInterface ----
     def add_rate_limited(self, item: Hashable) -> None:
+        self._m_retries.inc()
         self.add_after(item, self._limiter.when(item))
 
     def forget(self, item: Hashable) -> None:

@@ -25,6 +25,16 @@ def setup_signal_handler() -> threading.Event:
     def handler(signum, frame):
         if stop.is_set():
             os._exit(1)  # second signal: exit directly
+        # flight-recorder post-mortem (ISSUE 5): a terminating pod's
+        # log is the one artifact the kubelet keeps, so the last
+        # reconcile outcomes go there before shutdown begins.  Strictly
+        # contained — telemetry must never block the stop signal.
+        try:
+            from .observability.recorder import flight_recorder
+
+            flight_recorder().log_dump()
+        except Exception:
+            pass
         stop.set()
 
     signal.signal(signal.SIGINT, handler)

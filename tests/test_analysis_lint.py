@@ -521,6 +521,115 @@ class TestDeleteWithoutOwnershipCheck:
 
 
 # ---------------------------------------------------------------------------
+# unregistered-metric
+# ---------------------------------------------------------------------------
+
+
+class TestUnregisteredMetric:
+    def test_direct_construction_fires_once(self):
+        v = only(
+            run(
+                """
+                from agac_tpu.observability.metrics import Counter
+
+                calls = Counter("agac_calls_total", "help", "counter")
+                """
+            ),
+            "unregistered-metric",
+        )
+        assert "bypasses the registry" in v.message
+
+    def test_module_attribute_construction_fires(self):
+        only(
+            run(
+                """
+                from agac_tpu.observability import metrics
+
+                depth = metrics.Gauge("agac_depth", "help", "gauge")
+                """
+            ),
+            "unregistered-metric",
+        )
+
+    def test_relative_import_construction_fires(self):
+        only(
+            run(
+                """
+                from .metrics import Histogram
+
+                lat = Histogram("agac_lat", "help", "histogram")
+                """,
+                path="agac_tpu/observability/instruments.py",
+            ),
+            "unregistered-metric",
+        )
+
+    def test_collections_counter_is_clean(self):
+        # provenance-tracked: only the observability primitives count
+        assert (
+            run(
+                """
+                from collections import Counter
+
+                tally = Counter()
+                """
+            )
+            == []
+        )
+
+    def test_registry_factory_with_literals_is_clean(self):
+        assert (
+            run(
+                """
+                def build(registry):
+                    return registry.counter(
+                        "agac_sweeps_total", "sweeps", labels=("kind",)
+                    )
+                """
+            )
+            == []
+        )
+
+    def test_non_literal_metric_name_fires(self):
+        v = only(
+            run(
+                """
+                def build(registry, name):
+                    return registry.counter(name, "help")
+                """
+            ),
+            "unregistered-metric",
+        )
+        assert "non-literal metric name" in v.message
+
+    def test_non_literal_label_names_fire(self):
+        v = only(
+            run(
+                """
+                def build(registry, label_set):
+                    return registry.gauge("agac_depth", "help", labels=label_set)
+                """
+            ),
+            "unregistered-metric",
+        )
+        assert "cardinality" in v.message
+
+    def test_metrics_module_itself_is_exempt(self):
+        # the registry module is where the primitives are constructed
+        assert (
+            run(
+                """
+                from agac_tpu.observability.metrics import Counter
+
+                child = Counter("agac_x_total", "help", "counter")
+                """,
+                path="agac_tpu/observability/metrics.py",
+            )
+            == []
+        )
+
+
+# ---------------------------------------------------------------------------
 # the repo itself + CI wiring
 # ---------------------------------------------------------------------------
 
@@ -536,6 +645,7 @@ def test_rule_registry_ships_the_documented_rules():
         "drift-read-outside-read-plane",
         "unbounded-poll-loop",
         "delete-without-ownership-check",
+        "unregistered-metric",
     }
 
 

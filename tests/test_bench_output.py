@@ -116,3 +116,46 @@ def test_detail_artifact_written_and_complete(bench_run, detail_path):
     # baseline ran the same mixed workload
     assert detail["baseline"]["n_bindings"] >= 1
     assert detail["baseline"]["n_ingresses"] >= 1
+
+
+def test_metrics_snapshot_scraped_per_phase(bench_run, detail_path):
+    """The observability plane's bench integration (ISSUE 5): every
+    phase ends with a real HTTP scrape of /metrics off the process
+    registry, parsed and condensed into a ``metrics_snapshot`` block —
+    so a metrics regression (a family gone dark, exposition that stops
+    parsing) shows up in the bench trajectory."""
+    with open(detail_path) as f:
+        detail = json.load(f)
+    for phase in ("baseline", "tuned", "drift_tick"):
+        snap = detail[phase]["metrics_snapshot"]
+        assert snap["series_total"] > 0, f"{phase}: empty scrape"
+        # the acceptance families are all present
+        for family in (
+            "agac_workqueue_depth",
+            "agac_workqueue_adds_total",
+            "agac_workqueue_queue_duration_seconds",
+            "agac_reconcile_results_total",
+            "agac_aws_api_calls_total",
+            "agac_reconcile_duration_seconds",
+        ):
+            assert family in snap["families"], f"{phase}: {family} missing"
+
+    tuned = detail["tuned"]["metrics_snapshot"]["key_series"]
+
+    def total(prefix: str, needle: str = "") -> float:
+        return sum(
+            v for name, v in tuned.items()
+            if name.startswith(prefix) and needle in name
+        )
+
+    # the fleet's convergence is visible in the series values: adds per
+    # queue, successful reconciles, successful AWS calls
+    assert total("agac_workqueue_adds_total{") > 0
+    assert total("agac_reconcile_results_total{", 'result="success"') > 0
+    assert total("agac_aws_api_calls_total{", 'outcome="success"') > 0
+    # GC sweep counters appear in the drift phase's scrape (two
+    # explicit sweeps over the converged fleet, zero deletions)
+    drift = detail["drift_tick"]["metrics_snapshot"]["key_series"]
+    assert drift.get("agac_gc_sweeps_total", 0) >= 2
+    assert drift.get('agac_gc_deleted_total{kind="accelerators"}', -1) == 0
+    assert drift.get('agac_gc_deleted_total{kind="records"}', -1) == 0

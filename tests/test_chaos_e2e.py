@@ -530,6 +530,138 @@ class TestChaosFleet:
             server.shutdown()
             server.server_close()
 
+    def test_metrics_scrape_live_under_fault_injection(self):
+        """The observability acceptance drill (ISSUE 5): while a chaos
+        fault plan is failing random AWS calls over a converging
+        fleet, ``GET /metrics`` must return valid Prometheus text
+        exposition carrying workqueue depth/latency, per-service AWS
+        call outcome counters, circuit-state gauges and GC sweep
+        counters — and the error counters must MOVE between a scrape
+        taken before the drill and one taken after it.  The flight
+        recorder's endpoint must carry the same reconciles."""
+        from agac_tpu.cloudprovider.aws.health import ELBV2_OPS
+        from agac_tpu.controllers import GarbageCollectorConfig
+        from agac_tpu.observability import metrics as obs_metrics
+
+        n = 6
+        cluster = FakeCluster()
+        aws = chaotic_backend(seed=20260804, fault_budget=40, p=0.3)
+        # min_calls far above the drill's traffic: the circuit gauges
+        # must be PRESENT (and read closed), not trip mid-convergence
+        tracker = HealthTracker(
+            HealthConfig(window=5.0, min_calls=10_000, aimd_qps=0),
+            registry=obs_metrics.registry(),
+        )
+
+        def cloud_factory(region):
+            return AWSDriver(
+                tracker.guard(aws, "globalaccelerator", GA_OPS),
+                tracker.guard(aws, f"elbv2[{region}]", ELBV2_OPS),
+                tracker.guard(aws, "route53", ROUTE53_OPS),
+                poll_interval=0.01, poll_timeout=2.0,
+                lb_not_active_retry=0.05, accelerator_missing_retry=0.05,
+            )
+
+        for i in range(n):
+            aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+
+        server = make_health_server(0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+
+        def scrape() -> dict:
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as response:
+                assert response.status == 200
+                assert response.headers["Content-Type"].startswith("text/plain")
+                text = response.read().decode()
+            return obs_metrics.parse_text(text)  # raises on malformed lines
+
+        def family_total(samples: dict, prefix: str, exclude: str = "") -> float:
+            return sum(
+                v for name, v in samples.items()
+                if name.startswith(prefix) and (not exclude or exclude not in name)
+            )
+
+        before = scrape()
+        stop = threading.Event()
+        config = fleet_config(workers=4)
+        config.garbage_collector = GarbageCollectorConfig(
+            interval=3600.0, grace_sweeps=2, max_deletes=10
+        )
+        manager = Manager(
+            resync_period=0.3, health=tracker,
+            metrics_registry=obs_metrics.registry(),
+        )
+        manager.run(cluster, config, stop, cloud_factory=cloud_factory, block=False)
+        try:
+            for i in range(n):
+                cluster.create(
+                    "Service",
+                    make_lb_service(name=f"svc{i}", hostname=nlb_hostname(i)),
+                )
+            assert wait_until(
+                lambda: all(
+                    chain_complete(aws, f"service/default/svc{i}", nlb_hostname(i))
+                    for i in range(n)
+                ),
+                timeout=30.0,
+            )
+            # two GC sweeps over the live fleet (informers are synced
+            # once convergence completed)
+            assert wait_until(manager.gc._synced, timeout=10.0)
+            for _ in range(2):
+                report = manager.gc_sweep()
+                assert report["skipped_unsynced"] is False
+            after = scrape()
+
+            # the acceptance families, live in one exposition
+            assert family_total(after, "agac_workqueue_depth{") >= 0
+            assert (
+                family_total(after, "agac_workqueue_queue_duration_seconds_count{")
+                > 0
+            )
+            assert (
+                after['agac_circuit_state{service="globalaccelerator"}'] == 0
+            )  # present AND closed
+            assert after["agac_gc_sweeps_total"] - before.get(
+                "agac_gc_sweeps_total", 0
+            ) == 2
+            assert after.get('agac_gc_deleted_total{kind="accelerators"}', 0) == before.get(
+                'agac_gc_deleted_total{kind="accelerators"}', 0
+            ), "GC deleted live resources during the drill"
+
+            # the drill's chaos faults moved the error counters: AWS
+            # calls with non-success outcomes and error reconciles both
+            # advanced between the scrapes
+            failed_calls = family_total(
+                after, "agac_aws_api_calls_total{", exclude='outcome="success"'
+            ) - family_total(
+                before, "agac_aws_api_calls_total{", exclude='outcome="success"'
+            )
+            assert failed_calls > 0, "chaos faults left no outcome counters"
+            error_results = family_total(
+                after, "agac_reconcile_results_total{", exclude='result="success"'
+            ) - family_total(
+                before, "agac_reconcile_results_total{", exclude='result="success"'
+            )
+            assert error_results > 0, "chaos faults left no reconcile error counts"
+            successes = family_total(
+                after, 'agac_reconcile_results_total{'
+            ) - error_results
+            assert successes > 0
+
+            # the flight recorder saw the same reconciles
+            with urllib.request.urlopen(
+                base + "/debug/flightrecorder", timeout=5
+            ) as response:
+                dump = json.loads(response.read())
+            kinds = {entry["kind"] for entry in dump["entries"]}
+            assert "reconcile" in kinds and "gc-sweep" in kinds
+        finally:
+            stop.set()
+            server.shutdown()
+            server.server_close()
+
     def test_orphan_storm_swept_after_outage_with_zero_false_positives(self):
         """The ISSUE 4 orphan-storm drill: 25 Services deleted while
         the controller is DOWN (the delete events are gone forever —

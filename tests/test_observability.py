@@ -1,0 +1,464 @@
+"""Unit tier for the observability plane (ISSUE 5):
+``agac_tpu/observability/`` — registry thread-safety, histogram bucket
+math, the exposition-format golden test, the label-cardinality cap,
+span lifecycle + sampling on a fake clock, flight-recorder wraparound,
+and the ``/metrics`` + ``/debug/flightrecorder`` endpoints on the
+manager's health server.  The live fault-injected scrape lives in
+``tests/test_chaos_e2e.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from agac_tpu.manager import make_health_server
+from agac_tpu.observability import trace as trace_mod
+from agac_tpu.observability.catalog import BEGIN, END, render_table
+from agac_tpu.observability.instruments import instrument_api, register_all
+from agac_tpu.observability.metrics import (
+    CONTENT_TYPE,
+    MetricsRegistry,
+    parse_text,
+)
+from agac_tpu.observability.recorder import FlightRecorder
+from agac_tpu.observability.trace import Tracer
+from agac_tpu.reconcile import RateLimitingQueue, process_next_work_item
+from agac_tpu.reconcile.result import Result
+
+
+class FakeClock:
+    def __init__(self, now: float = 100.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_thread_safety_under_concurrent_increments(self):
+        reg = MetricsRegistry()
+        counter = reg.counter("t_total", "t", labels=("who",))
+        child = counter.labels(who="x")
+        n_threads, n_incs = 8, 2000
+
+        def worker():
+            for _ in range(n_incs):
+                child.inc()
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert child.value() == n_threads * n_incs
+
+    def test_get_or_create_returns_the_same_metric(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", "x")
+        b = reg.counter("x_total", "x")
+        assert a is b
+
+    def test_type_or_label_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", "x")
+        with pytest.raises(ValueError):
+            reg.gauge("x_total", "x")
+        with pytest.raises(ValueError):
+            reg.counter("x_total", "x", labels=("a",))
+
+    def test_counters_refuse_to_go_down(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("x_total", "x").inc(-1)
+
+    def test_wrong_label_names_raise(self):
+        reg = MetricsRegistry()
+        metric = reg.counter("x_total", "x", labels=("a",))
+        with pytest.raises(ValueError):
+            metric.labels(b="1")
+
+    def test_histogram_bucket_math(self):
+        reg = MetricsRegistry()
+        hist = reg.histogram("lat_seconds", "l", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            hist.observe(v)
+        samples = parse_text(reg.render())
+        # buckets are CUMULATIVE: le=0.1 holds 1, le=1 holds 3, ...
+        assert samples['lat_seconds_bucket{le="0.1"}'] == 1
+        assert samples['lat_seconds_bucket{le="1"}'] == 3
+        assert samples['lat_seconds_bucket{le="10"}'] == 4
+        assert samples['lat_seconds_bucket{le="+Inf"}'] == 5
+        assert samples["lat_seconds_count"] == 5
+        assert samples["lat_seconds_sum"] == pytest.approx(56.05)
+
+    def test_exposition_format_golden(self):
+        """The exact text a scraper sees: HELP/TYPE headers, sorted
+        families, label escaping, histogram expansion."""
+        reg = MetricsRegistry()
+        reg.counter("b_total", "b counts", labels=("op",)).labels(op="x").inc(3)
+        reg.gauge("a_depth", "a depth").set(2)
+        hist = reg.histogram("c_seconds", "c latency", buckets=(0.5, 1.0))
+        hist.observe(0.25)
+        assert reg.render() == (
+            "# HELP a_depth a depth\n"
+            "# TYPE a_depth gauge\n"
+            "a_depth 2\n"
+            "# HELP b_total b counts\n"
+            "# TYPE b_total counter\n"
+            'b_total{op="x"} 3\n'
+            "# HELP c_seconds c latency\n"
+            "# TYPE c_seconds histogram\n"
+            'c_seconds_bucket{le="0.5"} 1\n'
+            'c_seconds_bucket{le="1"} 1\n'
+            'c_seconds_bucket{le="+Inf"} 1\n'
+            "c_seconds_sum 0.25\n"
+            "c_seconds_count 1\n"
+        )
+
+    def test_label_value_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("esc_total", "e", labels=("k",)).labels(k='a"b\\c\nd').inc()
+        line = [
+            l for l in reg.render().splitlines() if l.startswith("esc_total{")
+        ][0]
+        assert line == 'esc_total{k="a\\"b\\\\c\\nd"} 1'
+
+    def test_label_cardinality_cap_collapses_to_overflow(self):
+        reg = MetricsRegistry(max_series=3)
+        metric = reg.counter("capped_total", "c", labels=("key",))
+        for i in range(10):
+            metric.labels(key=f"k{i}").inc()
+        samples = {
+            name: v
+            for name, v in parse_text(reg.render()).items()
+            if name.startswith("capped_total")
+        }
+        # 3 real series + ONE overflow series absorbing the other 7
+        assert len(samples) == 4
+        assert samples['capped_total{key="overflow"}'] == 7
+        assert metric.dropped_series == 7
+
+    def test_gauge_callback_is_a_live_view(self):
+        reg = MetricsRegistry()
+        state = {"v": 1.0}
+        reg.gauge("live", "l").set_function(lambda: state["v"])
+        assert parse_text(reg.render())["live"] == 1
+        state["v"] = 7.0
+        assert parse_text(reg.render())["live"] == 7
+
+    def test_callback_failure_renders_nan_not_crash(self):
+        reg = MetricsRegistry()
+        reg.gauge("bad", "b").set_function(lambda: 1 / 0)
+        assert "bad NaN" in reg.render()
+
+    def test_catalog_table_covers_every_registered_metric(self):
+        reg = register_all(MetricsRegistry())
+        table = render_table()
+        for desc in reg.describe():
+            assert f"`{desc['name']}`" in table
+        # the committed doc carries the generated block current
+        import pathlib
+
+        doc = (
+            pathlib.Path(__file__).resolve().parent.parent / "docs" / "operations.md"
+        ).read_text()
+        assert BEGIN in doc and END in doc
+        assert table in doc, "docs/operations.md catalog is stale — run `make metrics-catalog`"
+
+
+# ---------------------------------------------------------------------------
+# trace spans
+# ---------------------------------------------------------------------------
+
+
+class TestTrace:
+    def test_span_lifecycle_on_a_fake_clock(self):
+        clock = FakeClock()
+        emitted = []
+        tracer = Tracer(sample_rate=1.0, clock=clock, emit=emitted.append)
+        tr = tracer.start("ctrl", "ns/obj", queue_wait=0.5)
+        assert tr is not None
+        with trace_mod.activate(tr):
+            with trace_mod.span("sync"):
+                clock.advance(2.0)
+                trace_mod.record_call(
+                    "globalaccelerator", "list_accelerators",
+                    clock.now - 0.25, clock.now, "success",
+                )
+        tr.annotate(result="success")
+        clock.advance(0.5)
+        tracer.finish(tr)
+        assert len(emitted) == 1
+        payload = emitted[0]
+        assert payload["controller"] == "ctrl"
+        assert payload["key"] == "ns/obj"
+        assert payload["result"] == "success"
+        assert payload["dur"] == pytest.approx(2.5)
+        spans = {s["name"]: s for s in payload["spans"]}
+        assert spans["queue-wait"]["dur"] == pytest.approx(0.5)
+        assert spans["sync"]["dur"] == pytest.approx(2.0)
+        aws = spans["aws:globalaccelerator.list_accelerators"]
+        assert aws["dur"] == pytest.approx(0.25)
+        assert aws["attrs"]["outcome"] == "success"
+
+    def test_sampling_is_deterministic_every_nth(self):
+        tracer = Tracer(sample_rate=0.25, clock=FakeClock())
+        sampled = [tracer.start("c", f"k{i}") is not None for i in range(8)]
+        assert sampled == [False, False, False, True] * 2
+
+    def test_rate_zero_disables(self):
+        tracer = Tracer(sample_rate=0.0)
+        assert all(tracer.start("c", "k") is None for _ in range(5))
+
+    def test_unsampled_path_is_a_noop_everywhere(self):
+        tracer = Tracer(sample_rate=0.0)
+        tr = tracer.start("c", "k")
+        with trace_mod.activate(tr):
+            assert trace_mod.current() is None
+            with trace_mod.span("sync"):
+                trace_mod.record_call("ga", "op", 0.0, 1.0, "success")
+        tracer.finish(tr)  # must not raise or emit
+        assert tracer.emitted_total == 0
+
+    def test_span_records_exception_and_still_closes(self):
+        clock = FakeClock()
+        tracer = Tracer(sample_rate=1.0, clock=clock, emit=lambda p: None)
+        tr = tracer.start("c", "k")
+        with trace_mod.activate(tr):
+            with pytest.raises(RuntimeError):
+                with trace_mod.span("settle-poll", arn="a1"):
+                    clock.advance(1.0)
+                    raise RuntimeError("boom")
+        assert tr.spans[-1].name == "settle-poll"
+        assert tr.spans[-1].duration() == pytest.approx(1.0)
+        assert "boom" in tr.spans[-1].attrs["error"]
+
+    def test_emit_failure_is_contained(self):
+        def bad_emit(payload):
+            raise RuntimeError("collector down")
+
+        tracer = Tracer(sample_rate=1.0, emit=bad_emit)
+        tracer.finish(tracer.start("c", "k"))  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_wraparound_keeps_the_newest_entries_in_order(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(capacity=4, clock=clock)
+        for i in range(10):
+            clock.advance(1.0)
+            recorder.record("reconcile", key=f"k{i}")
+        assert len(recorder) == 4
+        entries = recorder.dump()
+        assert [e["key"] for e in entries] == ["k6", "k7", "k8", "k9"]
+        assert [e["seq"] for e in entries] == [7, 8, 9, 10]
+        assert entries[0]["time"] < entries[-1]["time"]
+        assert recorder.recorded_total == 10
+
+    def test_dump_limit_takes_the_tail(self):
+        recorder = FlightRecorder(capacity=8)
+        for i in range(5):
+            recorder.record("reconcile", key=f"k{i}")
+        assert [e["key"] for e in recorder.dump(limit=2)] == ["k3", "k4"]
+
+    def test_record_never_raises_on_unserializable_fields(self):
+        recorder = FlightRecorder(capacity=2)
+        recorder.record("reconcile", obj=object())  # stored as-is, no raise
+        assert len(recorder) == 1
+
+
+# ---------------------------------------------------------------------------
+# instrumented hot paths
+# ---------------------------------------------------------------------------
+
+
+class TestWorkqueueMetrics:
+    def test_standard_metric_set_moves_through_the_lifecycle(self):
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        queue = RateLimitingQueue(name="obs-test", clock=clock, metrics_registry=reg)
+        try:
+            queue.add("a")
+            queue.add("b")
+            queue.add("b")  # coalesced: counts once
+            samples = parse_text(reg.render())
+            assert samples['agac_workqueue_adds_total{name="obs-test"}'] == 2
+            assert samples['agac_workqueue_depth{name="obs-test"}'] == 2
+
+            clock.advance(0.2)
+            item, _ = queue.get()
+            assert queue.last_pop_wait() == pytest.approx(0.2)
+            clock.advance(0.05)
+            queue.done(item)
+            samples = parse_text(reg.render())
+            assert samples['agac_workqueue_depth{name="obs-test"}'] == 1
+            assert (
+                samples['agac_workqueue_queue_duration_seconds_count{name="obs-test"}']
+                == 1
+            )
+            assert samples[
+                'agac_workqueue_queue_duration_seconds_sum{name="obs-test"}'
+            ] == pytest.approx(0.2)
+            assert samples[
+                'agac_workqueue_work_duration_seconds_sum{name="obs-test"}'
+            ] == pytest.approx(0.05)
+
+            queue.add_rate_limited("a")
+            samples = parse_text(reg.render())
+            assert samples['agac_workqueue_retries_total{name="obs-test"}'] == 1
+        finally:
+            queue.shutdown()
+
+
+class TestReconcileMetrics:
+    def _drain(self, queue, process, registry=None):
+        process_next_work_item(
+            queue,
+            key_to_obj=lambda key: {"key": key},
+            process_delete=lambda key: Result(),
+            process_create_or_update=process,
+        )
+
+    def test_result_counters_and_recorder_move(self):
+        from agac_tpu.observability import instruments, metrics, recorder
+
+        results = instruments.reconcile_instruments().results
+        thread = threading.current_thread().name
+        ok_child = results.labels(controller=thread, result="success")
+        err_child = results.labels(controller=thread, result="error")
+        ok_before, err_before = ok_child.value(), err_child.value()
+        recorded_before = recorder.flight_recorder().recorded_total
+
+        queue = RateLimitingQueue(name="obs-reconcile")
+        try:
+            queue.add("ns/ok")
+            self._drain(queue, lambda obj: Result())
+            queue.add("ns/bad")
+
+            def boom(obj):
+                raise RuntimeError("boom")
+
+            self._drain(queue, boom)
+        finally:
+            queue.shutdown()
+
+        assert ok_child.value() == ok_before + 1
+        assert err_child.value() == err_before + 1
+        flight = recorder.flight_recorder().dump()[-2:]
+        assert [e["result"] for e in flight] == ["success", "error"]
+        assert "boom" in flight[-1]["error"]
+
+    def test_sampled_reconcile_emits_a_trace_with_queue_wait(self):
+        emitted = []
+        tracer = trace_mod.tracer()
+        old_emit = tracer._emit
+        tracer._emit = emitted.append
+        tracer.set_sample_rate(1.0)
+        try:
+            queue = RateLimitingQueue(name="obs-traced")
+            queue.add("ns/traced")
+            self._drain(queue, lambda obj: Result())
+            queue.shutdown()
+        finally:
+            tracer._emit = old_emit
+            tracer.set_sample_rate(0.0)
+        assert len(emitted) == 1
+        payload = emitted[0]
+        assert payload["key"] == "ns/traced"
+        assert payload["result"] == "success"
+        span_names = [s["name"] for s in payload["spans"]]
+        assert "queue-wait" in span_names and "sync" in span_names
+
+
+class TestInstrumentedAPI:
+    class FakeService:
+        def list_accelerators(self, token=None):
+            return [], None
+
+        def create_accelerator(self, name):
+            from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+
+            raise AWSAPIError("ThrottlingException", "slow down")
+
+        def helper(self):
+            return "passthrough"
+
+    def test_calls_and_outcomes_are_counted_per_op(self):
+        reg = MetricsRegistry()
+        api = instrument_api(
+            self.FakeService(),
+            "globalaccelerator",
+            frozenset({"list_accelerators", "create_accelerator"}),
+            registry=reg,
+        )
+        api.list_accelerators()
+        api.list_accelerators()
+        with pytest.raises(Exception):
+            api.create_accelerator("x")
+        assert api.helper() == "passthrough"
+        samples = parse_text(reg.render())
+        assert samples[
+            'agac_aws_api_calls_total{service="globalaccelerator",'
+            'op="list_accelerators",outcome="success"}'
+        ] == 2
+        assert samples[
+            'agac_aws_api_calls_total{service="globalaccelerator",'
+            'op="create_accelerator",outcome="ThrottlingException"}'
+        ] == 1
+        assert samples[
+            'agac_aws_api_call_duration_seconds_count'
+            '{service="globalaccelerator",op="list_accelerators"}'
+        ] == 2
+
+
+# ---------------------------------------------------------------------------
+# the health server endpoints
+# ---------------------------------------------------------------------------
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as response:
+        return response.status, response.headers.get("Content-Type"), response.read()
+
+
+class TestServerEndpoints:
+    def test_metrics_and_flightrecorder_endpoints(self):
+        reg = MetricsRegistry()
+        reg.counter("e2e_total", "e").inc(5)
+        recorder = FlightRecorder(capacity=4)
+        recorder.record("reconcile", key="ns/x", result="success")
+        server = make_health_server(0, metrics_registry=reg, flight_recorder=recorder)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            status, ctype, body = _get(base + "/metrics")
+            assert status == 200
+            assert ctype == CONTENT_TYPE
+            samples = parse_text(body.decode())
+            assert samples["e2e_total"] == 5
+
+            status, ctype, body = _get(base + "/debug/flightrecorder")
+            assert status == 200
+            dump = json.loads(body)
+            assert dump["capacity"] == 4
+            assert dump["entries"][0]["key"] == "ns/x"
+        finally:
+            server.shutdown()
+            server.server_close()
