@@ -407,7 +407,7 @@ _READ_PLANE_FUNCS = frozenset(
         "get_listener", "get_endpoint_group",
         "_fetch_record_sets", "_describe_load_balancers",
         "_list_all_hosted_zones", "_walk_hosted_zone",
-        "_list_related", "_delete_accelerator",
+        "_list_related", "_delete_accelerator", "_blocking_settle_poll",
         "update_endpoint_weight", "describe_endpoint_group",
         "verify_accelerator_orphan",
     }
@@ -517,6 +517,70 @@ def check_unbounded_poll_loop(tree: ast.Module, ctx: LintContext) -> Iterator[Vi
             "poll loop sleeps without consulting a deadline or the health "
             "plane — a wedged backend holds this worker forever; check "
             "`api_health.check_deadline(...)` (or a local deadline) each turn",
+        )
+
+
+# ---------------------------------------------------------------------------
+# blocking-settle-in-worker
+# ---------------------------------------------------------------------------
+
+# a settle loop re-checks remote state between sleeps: the read half
+_SETTLE_RECHECK = re.compile(r"^(describe_|list_)")
+
+
+@rule(
+    "blocking-settle-in-worker",
+    "settle/wait loops (sleep + describe/list re-check) may not run in "
+    "process_next_work_item-reachable code — park the item in the "
+    "pending-settle table (reconcile/pending.py) instead of holding a worker",
+)
+def check_blocking_settle_in_worker(
+    tree: ast.Module, ctx: LintContext
+) -> Iterator[Violation]:
+    """The async mutation pipeline (ISSUE 6) exists so workers never
+    sleep through AWS wait states.  Any ``while`` loop that both
+    sleeps AND re-reads remote state (``describe_*``/``list_*``) in
+    ``cloudprovider/``, ``controllers/`` or ``reconcile/`` is a settle
+    poll holding a worker — it must raise ``SettleWait`` and let the
+    poll-tick scheduler re-check parked chains coalesced.  The
+    scheduler itself (``reconcile/pending.py``) is the one sanctioned
+    home; the driver's reference-parity fallback carries an explicit
+    justified suppression."""
+    parts = ctx.path.parts
+    if (
+        "cloudprovider" not in parts
+        and "controllers" not in parts
+        and "reconcile" not in parts
+    ):
+        return
+    if ctx.path.name == "pending.py" and "reconcile" in parts:
+        return  # the pending-settle scheduler is the sanctioned home
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While):
+            continue
+        sleeps = any(
+            isinstance(inner, ast.Call)
+            and (name := _call_target_name(inner)) is not None
+            and _SLEEPISH.search(name)
+            for inner in ast.walk(node)
+        )
+        if not sleeps:
+            continue
+        rechecks = any(
+            isinstance(inner, ast.Call)
+            and (name := _call_target_name(inner)) is not None
+            and _SETTLE_RECHECK.match(name)
+            for inner in ast.walk(node)
+        )
+        if not rechecks:
+            continue
+        yield Violation(
+            "blocking-settle-in-worker",
+            str(ctx.path),
+            node.lineno,
+            "settle loop (sleep + describe/list re-check) holds a worker — "
+            "raise SettleWait so the pending-settle scheduler re-checks the "
+            "parked chain in its coalesced poll tick instead",
         )
 
 

@@ -185,9 +185,22 @@ class DiscoveryCache:
         ttl: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
         degraded: Optional[Callable[[], bool]] = None,
+        tags_ttl: Optional[float] = None,
     ):
         self._ttl = ttl
         self._clock = clock
+        # incremental snapshot refresh (ISSUE 6): with tags_ttl set,
+        # a reload may REUSE the tags of accelerators the previous
+        # snapshot already knew (``reusable_tags``) instead of paying
+        # one ListTagsForResource per accelerator per reload — local
+        # writes are write-through (upsert) so they are always exact,
+        # and out-of-band TAG edits are re-detected within tags_ttl
+        # (a full tag re-list).  None (default) = legacy behavior:
+        # every reload re-reads every accelerator's tags, and the tag
+        # tamper-detection bound stays the snapshot TTL itself.
+        self._tags_ttl = tags_ttl
+        self._tags_loaded_at: Optional[float] = None
+        self._tags_refreshing = False
         # health-plane hook (factory wires it to "is the GA circuit
         # open"): while True, an expired snapshot is served stale
         # instead of dispatching a reload that is known to fail —
@@ -206,6 +219,8 @@ class DiscoveryCache:
         self.misses = 0
         self.waits = 0  # callers that parked behind another's load
         self.stale_serves = 0  # expired snapshots served while degraded
+        self.tag_full_refreshes = 0  # loads that re-read every tag set
+        self.tag_incremental_loads = 0  # loads that reused known tags
 
     def stats(self) -> dict:
         with self._lock:
@@ -214,6 +229,34 @@ class DiscoveryCache:
                 "misses": self.misses,
                 "waits": self.waits,
                 "stale_serves": self.stale_serves,
+                "tag_full_refreshes": self.tag_full_refreshes,
+                "tag_incremental_loads": self.tag_incremental_loads,
+            }
+
+    def reusable_tags(self) -> dict:
+        """arn → tags the in-flight loader may reuse instead of
+        re-listing live (the incremental-refresh seam the driver's
+        ``_load_discovery_snapshot`` consults).  Empty when the cache
+        holds nothing, when incremental refresh is off (tags_ttl
+        None), or when the tag set is due for a full re-read — the
+        load that receives {} IS the full refresh, and its successful
+        store restamps the tag clock."""
+        with self._lock:
+            now = self._clock()
+            due = (
+                self._tags_ttl is None
+                or self._snapshot is None
+                or self._tags_loaded_at is None
+                or now >= self._tags_loaded_at + self._tags_ttl
+            )
+            if due:
+                self.tag_full_refreshes += 1
+                self._tags_refreshing = True
+                return {}
+            self.tag_incremental_loads += 1
+            return {
+                accelerator.accelerator_arn: tags
+                for accelerator, tags in self._snapshot
             }
 
     def get(self, loader: Callable[[], Snapshot]) -> Snapshot:
@@ -254,6 +297,7 @@ class DiscoveryCache:
             with self._lock:
                 self._load_event = None
                 self._journal = None
+                self._tags_refreshing = False
             event.set()
             raise
         with self._lock:
@@ -278,11 +322,25 @@ class DiscoveryCache:
             if discard:
                 self._snapshot = None
                 self._expires = 0.0
+                self._tags_refreshing = False
             else:
                 self._snapshot = snapshot
                 self._expires = self._clock() + self._ttl
+                if self._tags_refreshing:
+                    # this load was a full tag refresh: restart the
+                    # incremental-reuse window from its completion
+                    self._tags_loaded_at = self._clock()
+                    self._tags_refreshing = False
         event.set()
         return snapshot
+
+    def peek(self) -> Optional[Snapshot]:
+        """The current snapshot WITHOUT loading, even when expired —
+        the settle poller's read (reconcile/pending.py): local writes
+        are upserted write-through so the peek is exact for them, and
+        the scheduler thread must never dispatch an O(N) scan."""
+        with self._lock:
+            return self._snapshot
 
     def invalidate(self) -> None:
         """External/unknown change: drop the snapshot, and poison any

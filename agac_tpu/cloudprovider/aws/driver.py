@@ -37,6 +37,7 @@ from typing import Callable, Optional
 from ... import apis, klog
 from ...observability import trace
 from ...observability.instruments import instrument_api
+from ...reconcile.pending import SETTLE_FAILED, SETTLE_READY, SettleWait
 from . import health as api_health
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
 from .errors import (
@@ -304,6 +305,17 @@ class _PartialCreate(Exception):
         super().__init__(str(cause))
 
 
+def _poll_batch_tickets(tickets: list) -> dict:
+    """Settle check for items parked on an async Route53 change-batch
+    commit: pure in-memory ticket state, no wire traffic — the batch
+    leader already did (or will do) the one coalesced call."""
+    return {
+        ticket: (SETTLE_FAILED if ticket.error is not None else SETTLE_READY)
+        for ticket in tickets
+        if ticket.done()
+    }
+
+
 class AWSDriver:
     """High-level ensure/cleanup operations over the three services.
 
@@ -327,6 +339,9 @@ class AWSDriver:
         topology_cache=None,
         record_cache=None,
         lb_coalescer=None,
+        settle_table=None,
+        change_batcher=None,
+        stage_requeue: float = 0.0,
     ):
         # the observability plane's driver hook (ISSUE 5): every call
         # through these handles is timed into the per-service/per-op
@@ -357,6 +372,34 @@ class AWSDriver:
         self._topology_cache = topology_cache
         self._record_cache = record_cache
         self._lb_coalescer = lb_coalescer
+        # the async mutation pipeline (ISSUE 6), all opt-in:
+        # - settle_table: a reconcile.PendingSettleTable — wait states
+        #   (accelerator settling, change-batch commits, the Route53
+        #   wait-for-accelerator dependency) PARK the item there via
+        #   SettleWait instead of holding a worker in a sleep loop;
+        # - change_batcher: the per-zone Route53 ChangeBatcher — record
+        #   mutations coalesce into multi-change wire calls;
+        # - stage_requeue > 0: the accelerator→listener→EG chain runs
+        #   as resumable one-mutate stages (each stage requeues after
+        #   this delay), so independent objects' stages interleave
+        #   under the mutate quota instead of one object holding a
+        #   worker end-to-end.
+        self._settle_table = settle_table
+        self._change_batcher = change_batcher
+        self._stage_requeue = stage_requeue
+        if settle_table is not None:
+            # re-registration per driver construction is idempotent;
+            # GA and Route53 are global services, so the last driver's
+            # handles answering is correct for any region
+            settle_table.register_poller(
+                "ga-accelerator-settle", self._poll_parked_accelerators
+            )
+            settle_table.register_poller(
+                "route53-accelerator-wait", self._poll_accelerator_hostnames
+            )
+            settle_table.register_poller(
+                "route53-change-batch", _poll_batch_tickets
+            )
 
     # ------------------------------------------------------------------
     # ELBv2
@@ -400,10 +443,27 @@ class AWSDriver:
         return self._drain_pages(lambda token: self.ga.list_accelerators(100, token))
 
     def _load_discovery_snapshot(self) -> list[tuple[Accelerator, list[Tag]]]:
-        return [
-            (accelerator, self.ga.list_tags_for_resource(accelerator.accelerator_arn))
-            for accelerator in self._list_accelerators()
-        ]
+        """One snapshot load: a ListAccelerators drain plus tags.
+        With the cache's incremental-refresh window open
+        (``reusable_tags``), tags of already-known accelerators come
+        from the previous snapshot (exact for our own writes — they are
+        write-through upserted) and only NEW arns pay a live
+        ListTagsForResource; a full tag re-list still runs every
+        ``tags_ttl`` (the out-of-band tag-edit detection bound).  This
+        kills the O(N)-tag-reads-per-reload hot spot that stalled every
+        worker behind each snapshot refresh (ISSUE 6 satellite)."""
+        known = (
+            self._discovery_cache.reusable_tags()
+            if self._discovery_cache is not None
+            else {}
+        )
+        pairs = []
+        for accelerator in self._list_accelerators():
+            tags = known.get(accelerator.accelerator_arn)
+            if tags is None:
+                tags = self.ga.list_tags_for_resource(accelerator.accelerator_arn)
+            pairs.append((accelerator, tags))
+        return pairs
 
     def _invalidate_discovery(self) -> None:
         if self._discovery_cache is not None:
@@ -469,6 +529,51 @@ class AWSDriver:
                 CLUSTER_TAG_KEY: cluster_name,
             }
         )
+
+    # ------------------------------------------------------------------
+    # pending-settle pollers (the async mutation pipeline, ISSUE 6)
+    # ------------------------------------------------------------------
+    def _poll_parked_accelerators(self, arns: list) -> dict:
+        """Coalesced settle check for parked teardown chains: ONE
+        ListAccelerators drain answers every parked ARN (GA has no
+        batch describe), instead of the per-item describe loop the
+        blocking poll paid.  A missing ARN is READY — the resumed
+        delete path sees NotFound and completes as a no-op."""
+        status = {
+            accelerator.accelerator_arn: accelerator.status
+            for accelerator in self._list_accelerators()
+        }
+        return {
+            arn: SETTLE_READY
+            for arn in arns
+            if status.get(arn, ACCELERATOR_STATUS_DEPLOYED)
+            == ACCELERATOR_STATUS_DEPLOYED
+        }
+
+    def _poll_accelerator_hostnames(self, tokens: list) -> dict:
+        """Settle check for Route53 ensures parked on the GA
+        controller's convergence: a PEEK at the shared discovery
+        snapshot — no load, no wire call; the GA controller's own
+        creates write through into the snapshot the moment they land —
+        answers every ``(hostname, cluster)`` token.  With no snapshot
+        nothing resolves and the parked items fall back to their
+        deadline requeue: exactly the legacy retry cadence."""
+        if self._discovery_cache is None:
+            return {}
+        snapshot = self._discovery_cache.peek()
+        if snapshot is None:
+            return {}
+        ready = {}
+        for token in tokens:
+            hostname, cluster_name = token
+            want = {
+                MANAGED_TAG_KEY: "true",
+                TARGET_HOSTNAME_TAG_KEY: hostname,
+                CLUSTER_TAG_KEY: cluster_name,
+            }
+            if any(tags_contains_all_values(tags, want) for _, tags in snapshot):
+                ready[token] = SETTLE_READY
+        return ready
 
     # ------------------------------------------------------------------
     # Global Accelerator: orphan GC support (ISSUE 4)
@@ -600,6 +705,19 @@ class AWSDriver:
         )
         if not pairs:
             klog.infof("Creating Global Accelerator for %s", lb.dns_name)
+            if self._stage_requeue > 0:
+                # interleaved mode (ISSUE 6): stage 1 creates ONLY the
+                # accelerator (one mutate) and yields the worker; the
+                # requeued passes resume through the update path's
+                # create-if-missing levels — listener on pass 2,
+                # endpoint group on pass 3 — so independent objects'
+                # stages interleave under the mutate quota instead of
+                # one object holding a worker across the whole chain.
+                # No _PartialCreate rollback is needed: a single-call
+                # stage cannot tear, and the later levels are the same
+                # create-if-missing repairs a crash recovery runs.
+                arn = self._create_accelerator_stage(resource, obj, lb, cluster_name)
+                return arn, True, self._stage_requeue
             try:
                 arn = self._create_accelerator_chain(
                     resource, obj, lb, cluster_name, region, listener_spec
@@ -614,11 +732,12 @@ class AWSDriver:
                 raise partial.cause
             return arn, True, 0.0
 
+        in_progress = False
         for accelerator, tags in pairs:
             klog.infof(
                 "Updating existing Global Accelerator %s", accelerator.accelerator_arn
             )
-            self._update_accelerator_chain(
+            in_progress |= self._update_accelerator_chain(
                 resource,
                 obj,
                 accelerator,
@@ -629,7 +748,30 @@ class AWSDriver:
                 protocol_changed,
                 port_changed,
             )
-        return pairs[0][0].accelerator_arn, False, 0.0
+        retry_after = self._stage_requeue if in_progress else 0.0
+        return pairs[0][0].accelerator_arn, False, retry_after
+
+    def _create_accelerator_stage(
+        self, resource: str, obj, lb: LoadBalancer, cluster_name: str
+    ) -> str:
+        """Stage 1 of the interleaved create: the accelerator itself
+        (one mutate call), write-through into the discovery snapshot
+        so the requeued pass finds it by tags immediately."""
+        ns, name = obj.metadata.namespace, obj.metadata.name
+        ga_name = accelerator_name(resource, obj)
+        klog.infof("Creating Global Accelerator %s (staged)", ga_name)
+        tags = [
+            Tag(MANAGED_TAG_KEY, "true"),
+            Tag(OWNER_TAG_KEY, accelerator_owner_tag_value(resource, ns, name)),
+            Tag(TARGET_HOSTNAME_TAG_KEY, lb.dns_name),
+            Tag(CLUSTER_TAG_KEY, cluster_name),
+        ] + accelerator_tags_from_annotations(obj)
+        accelerator = self.ga.create_accelerator(
+            ga_name, IP_ADDRESS_TYPE_IPV4, True, tags
+        )
+        self._discovery_upsert(accelerator, tags)
+        klog.infof("Global Accelerator is created: %s", accelerator.accelerator_arn)
+        return accelerator.accelerator_arn
 
     def _create_accelerator_chain(
         self, resource: str, obj, lb: LoadBalancer, cluster_name: str, region: str, listener_spec
@@ -693,12 +835,18 @@ class AWSDriver:
         listener_spec,
         protocol_changed,
         port_changed,
-    ) -> None:
+    ) -> bool:
         """Three-level drift repair with create-if-missing at each
         level (reference ``global_accelerator.go:288-347``).  ``tags``
         is the snapshot tag set that matched this accelerator — the
         accelerator-level drift check reads it instead of re-listing
-        tags live (see ``_pairs_by_tags``)."""
+        tags live (see ``_pairs_by_tags``).
+
+        Returns True when the chain is still IN PROGRESS — in staged
+        mode (``stage_requeue`` > 0) the listener-create level yields
+        the worker after its one mutate and the caller requeues; the
+        endpoint-group level is always the chain tail, so completing
+        it returns False."""
         ns, name = obj.metadata.namespace, obj.metadata.name
         arn = accelerator.accelerator_arn
         if self._accelerator_changed(resource, obj, accelerator, tags, lb.dns_name):
@@ -731,6 +879,10 @@ class AWSDriver:
             self._topology_upsert_listener(arn, listener)
             klog.infof("Listener is created: %s", listener.listener_arn)
             endpoint_group = None
+            if self._stage_requeue > 0:
+                # staged mode: one mutate per pass — yield here, the
+                # requeued pass creates the endpoint group
+                return True
         if protocol_changed(listener, obj) or port_changed(listener, obj):
             klog.infof("Listener is changed, so updating: %s", listener.listener_arn)
             ports, protocol = listener_spec(obj)
@@ -771,6 +923,7 @@ class AWSDriver:
             )
             self._topology_upsert_endpoint_group(arn, updated)
         klog.infof("All resources are synced: %s", arn)
+        return False
 
     def _accelerator_changed(
         self, resource: str, obj, accelerator: Accelerator, tags: list[Tag], hostname: str
@@ -946,26 +1099,53 @@ class AWSDriver:
         return accelerator, listeners, endpoint_groups
 
     def _delete_accelerator(self, arn: str) -> None:
-        """Disable → poll until DEPLOYED → delete
-        (reference ``global_accelerator.go:724-765``; 10 s / 3 min poll).
+        """Disable → wait until DEPLOYED → delete
+        (reference ``global_accelerator.go:724-765``; 10 s / 3 min).
 
-        The poll consults the worker's reconcile deadline (health
-        plane) each turn: an accelerator that never settles raises the
-        retryable DeadlineExceeded instead of holding the worker for
-        the full poll timeout, and the sleep never overshoots what is
-        left on the deadline."""
-        klog.infof("Disabling Global Accelerator %s", arn)
-        self.ga.update_accelerator(arn, enabled=False)
-        self._invalidate_discovery()
+        Resumable by design: the current state is read first, so a
+        re-entered teardown (pending-settle requeue, crash recovery)
+        skips the disable it already committed instead of re-disabling
+        and resetting the settle clock.  With the pending-settle table
+        wired the wait PARKS the item (SettleWait — the poll-tick
+        scheduler re-checks every parked chain in one coalesced
+        ListAccelerators and requeues on DEPLOYED) and the worker goes
+        back to the queue; without it, the reference-parity blocking
+        poll runs, bounded by the reconcile deadline as before."""
+        accelerator = self.ga.describe_accelerator(arn)
+        if accelerator.enabled:
+            klog.infof("Disabling Global Accelerator %s", arn)
+            self.ga.update_accelerator(arn, enabled=False)
+            self._invalidate_discovery()
+            accelerator = self.ga.describe_accelerator(arn)
+        if accelerator.status != ACCELERATOR_STATUS_DEPLOYED:
+            if self._settle_table is not None:
+                raise SettleWait(
+                    "ga-accelerator-settle",
+                    arn,
+                    message=f"accelerator {arn} is {accelerator.status}",
+                    table=self._settle_table,
+                    timeout=self._poll_timeout,
+                )
+            self._blocking_settle_poll(arn)
+        self.ga.delete_accelerator(arn)
+        self._discovery_remove(arn)
+        klog.infof("Global Accelerator is deleted: %s", arn)
+
+    def _blocking_settle_poll(self, arn: str) -> None:
+        """The reference-parity settle poll: holds the worker between
+        describes (consulting the reconcile deadline each turn).  Kept
+        ONLY as the fallback when no pending-settle table is wired —
+        the lint rule ``blocking-settle-in-worker`` pins every other
+        worker-reachable settle loop out of existence."""
         deadline = time.monotonic() + self._poll_timeout
         with trace.span("settle-poll", arn=arn):
-            while True:
+            while True:  # agac-lint: ignore[blocking-settle-in-worker] -- reference-parity fallback when no pending-settle table is wired; deadline-bounded
                 accelerator = self.ga.describe_accelerator(arn)
                 if accelerator.status == ACCELERATOR_STATUS_DEPLOYED:
                     klog.infof(
                         "Global Accelerator %s is %s", arn, accelerator.status
                     )
-                    break
+                    return
                 if time.monotonic() >= deadline:
                     raise AWSAPIError(
                         "Timeout", f"accelerator {arn} did not settle within {self._poll_timeout}s"
@@ -979,9 +1159,6 @@ class AWSDriver:
                 if remaining is not None:
                     wait = min(wait, max(remaining, 0.0))
                 self._sleep(wait)
-        self.ga.delete_accelerator(arn)
-        self._discovery_remove(arn)
-        klog.infof("Global Accelerator is deleted: %s", arn)
 
     # ------------------------------------------------------------------
     # EndpointGroupBinding support (reference ``global_accelerator.go:567-603``)
@@ -1089,6 +1266,27 @@ class AWSDriver:
             return False, self._accelerator_missing_retry
         if not accelerators:
             klog.errorf("Could not find Global Accelerator for %s", lb_hostname)
+            if self._settle_table is not None and self._discovery_cache is not None:
+                # async pipeline: park on the cross-controller
+                # dependency instead of a blind fixed-interval requeue
+                # — the settle poller peeks the discovery snapshot
+                # (which the GA controller's creates write through)
+                # every tick, so the record lands within one tick of
+                # the accelerator existing; the legacy retry interval
+                # survives as the parked deadline fallback.
+                raise SettleWait(
+                    "route53-accelerator-wait",
+                    (lb_hostname, cluster_name),
+                    message=f"no Global Accelerator for {lb_hostname} yet",
+                    table=self._settle_table,
+                    # the poller resolves within one tick of the
+                    # accelerator appearing, so the deadline is only
+                    # the can't-see fallback (empty snapshot, GA
+                    # controller down) — 5x the legacy blind-requeue
+                    # interval keeps that failure mode bounded without
+                    # expiry storms during large creation waves
+                    timeout=self._accelerator_missing_retry * 5,
+                )
             return False, self._accelerator_missing_retry
         accelerator = accelerators[0]
 
@@ -1188,13 +1386,15 @@ class AWSDriver:
                 accelerator,
                 txt_action=CHANGE_ACTION_UPSERT if txt_owned else CHANGE_ACTION_CREATE,
                 a_action=CHANGE_ACTION_UPSERT if a_ours else CHANGE_ACTION_CREATE,
+                asynchronous=True,
             )
             return True
         if not need_records_update(record, accelerator):
             klog.infof("Do not need to update for %s, so skip it", record.name)
             return False
         self._change_alias_record(
-            hosted_zone, hostname, accelerator, CHANGE_ACTION_UPSERT
+            hosted_zone, hostname, accelerator, CHANGE_ACTION_UPSERT,
+            asynchronous=True,
         )
         klog.infof("RecordSet %s is updated", record.name)
         return False
@@ -1265,14 +1465,64 @@ class AWSDriver:
             hosted_zone_id, lambda: self._fetch_record_sets(hosted_zone_id)
         )
 
-    def _change_record_sets(self, hosted_zone_id: str, changes: list[Change]) -> None:
-        """The ONE write path to Route53: commits the batch, then folds
-        it into the zone snapshot (write-through).  A rejected batch
-        invalidates the snapshot — InvalidChangeBatch means our view
-        of the zone lied (CREATE of an existing record / DELETE of a
-        missing one), NoSuchHostedZone that the zone itself is gone —
-        so the backoff retry re-reads instead of re-failing for the
-        rest of the TTL."""
+    def _change_record_sets(
+        self, hosted_zone_id: str, changes: list[Change], asynchronous: bool = False
+    ) -> None:
+        """The ONE write path to Route53.
+
+        Direct mode (no batcher): commit, then fold into the zone
+        snapshot (write-through); a rejected batch invalidates the
+        snapshot — InvalidChangeBatch means our view of the zone lied
+        (CREATE of an existing record / DELETE of a missing one),
+        NoSuchHostedZone that the zone itself is gone — so the backoff
+        retry re-reads instead of re-failing for the rest of the TTL.
+
+        Batched mode (ISSUE 6): the submission coalesces with other
+        items' changes bound for the same zone into one multi-change
+        wire call; write-through fold and failure invalidation move
+        into the batcher (once per committed/failed batch), and this
+        submission's OWN error — not a co-batched item's — is what
+        surfaces here.  ``asynchronous`` additionally parks the item
+        in the pending-settle table instead of blocking the worker
+        through the linger (ensure hot path only; cleanup stays
+        synchronous — correctness-first, cold)."""
+        if self._change_batcher is not None:
+            commit = self.route53.change_resource_record_sets
+            fold = (
+                self._record_cache.apply_changes
+                if self._record_cache is not None
+                else None
+            )
+            invalidate = (
+                self._record_cache.invalidate
+                if self._record_cache is not None
+                else None
+            )
+            if asynchronous and self._settle_table is not None:
+                ticket = self._change_batcher.submit_async(
+                    hosted_zone_id, changes, commit, fold, invalidate
+                )
+                if ticket.done():
+                    # this thread led the batch (or it failed fast):
+                    # the outcome is already known — behave like the
+                    # synchronous path
+                    if ticket.error is not None:
+                        raise ticket.error
+                    return
+                raise SettleWait(
+                    "route53-change-batch",
+                    ticket,
+                    message=f"change batch for {hosted_zone_id} committing",
+                    table=self._settle_table,
+                    timeout=self._poll_timeout,
+                )
+            self._change_batcher.submit(
+                hosted_zone_id, changes, commit, fold, invalidate,
+                wait_check=lambda: api_health.check_deadline(
+                    f"change batch for {hosted_zone_id}"
+                ),
+            )
+            return
         try:
             self.route53.change_resource_record_sets(hosted_zone_id, changes)
         except AWSAPIError as err:
@@ -1337,6 +1587,7 @@ class AWSDriver:
         accelerator: Accelerator,
         txt_action: str,
         a_action: str,
+        asynchronous: bool = False,
     ) -> None:
         """TXT ownership record + A alias in one atomic change batch
         (replaces the reference's two separate CREATE calls,
@@ -1345,7 +1596,8 @@ class AWSDriver:
         an existing owned TXT it carries the surviving co-owner values;
         ``a_action`` is UPSERT when a surviving A already aliases this
         accelerator (TXT deleted out-of-band) so the pair repair never
-        wedges on CREATE-of-existing."""
+        wedges on CREATE-of-existing.  The pair is ONE submission, so
+        the change batcher can never split it across wire calls."""
         self._change_record_sets(
             hosted_zone.id,
             [
@@ -1371,6 +1623,7 @@ class AWSDriver:
                     ),
                 ),
             ],
+            asynchronous=asynchronous,
         )
 
     def _change_alias_record(
@@ -1379,6 +1632,7 @@ class AWSDriver:
         hostname: str,
         accelerator: Accelerator,
         action: str,
+        asynchronous: bool = False,
     ) -> None:
         self._change_record_sets(
             hosted_zone.id,
@@ -1398,6 +1652,7 @@ class AWSDriver:
                     ),
                 )
             ],
+            asynchronous=asynchronous,
         )
 
     def cleanup_record_set(

@@ -38,6 +38,8 @@ import threading
 
 from ...observability import instruments as obs_instruments
 from ...observability import metrics as obs_metrics
+from ...reconcile.pending import PendingSettleTable
+from .batcher import ChangeBatcher
 from .cache import (
     AcceleratorTopologyCache,
     DiscoveryCache,
@@ -60,6 +62,10 @@ _zone_cache: HostedZoneCache | None = None
 _topology_cache: AcceleratorTopologyCache | None = None
 _record_cache: RecordSetCache | None = None
 _lb_coalescers: dict[str, LoadBalancerCoalescer] = {}
+# the async mutation pipeline (ISSUE 6): one pending-settle table and
+# one per-zone change batcher per process, shared by every driver
+_settle_table: PendingSettleTable | None = None
+_change_batcher: ChangeBatcher | None = None
 
 # memoized TTL values (env parsed once per process; a malformed value
 # must not poison every reconcile — fall back and say so once)
@@ -98,6 +104,88 @@ def configure_read_plane(ttl: float | None) -> None:
         "AGAC_LB_CACHE_TTL",
     ):
         _ttl_overrides[name] = ttl
+
+
+def configure_pipeline(
+    settle_poll_interval: float | None = None,
+    r53_batch_max: float | None = None,
+    r53_batch_linger: float | None = None,
+) -> None:
+    """Pin the async-mutation-pipeline knobs from the CLI
+    (``--settle-poll-interval`` / ``--r53-batch-max`` /
+    ``--r53-batch-linger``); ``None`` keeps the per-knob environment
+    variables / defaults.  settle interval 0 disables the
+    pending-settle table (reference-parity blocking settle); linger 0
+    disables Route53 change batching (one wire call per mutation)."""
+    for name, value in (
+        ("AGAC_SETTLE_POLL_INTERVAL", settle_poll_interval),
+        ("AGAC_R53_BATCH_MAX", r53_batch_max),
+        ("AGAC_R53_BATCH_LINGER", r53_batch_linger),
+    ):
+        if value is not None:
+            _ttl_overrides[name] = value
+
+
+def settle_poll_interval() -> float:
+    """The pending-settle scheduler's tick period: each tick re-checks
+    every parked chain in coalesced reads.  1 s default — the checks
+    are one ListAccelerators for all parked teardowns plus pure
+    in-memory peeks, so a tight tick is cheap and convergence latency
+    for resolved waits stays ~1 s.  0 disables the whole table."""
+    return _env_float("AGAC_SETTLE_POLL_INTERVAL", 1.0)
+
+
+def shared_settle_table() -> PendingSettleTable | None:
+    """The process-wide pending-settle table, or None when disabled
+    (``AGAC_SETTLE_POLL_INTERVAL=0``).  The manager runs the poll-tick
+    scheduler over it (``Manager.run``)."""
+    global _settle_table
+    if settle_poll_interval() <= 0:
+        return None
+    with _lock:
+        if _settle_table is None:
+            _settle_table = PendingSettleTable(registry=obs_metrics.registry())
+        return _settle_table
+
+
+def shared_change_batcher() -> ChangeBatcher | None:
+    """The process-wide per-zone Route53 change batcher, or None when
+    disabled (``AGAC_R53_BATCH_LINGER=0``, the default — batching is
+    opt-in until a deployment raises the linger; see docs/operations.md
+    "Async mutation pipeline")."""
+    global _change_batcher
+    linger = _env_float("AGAC_R53_BATCH_LINGER", 0.0)
+    if linger <= 0:
+        return None
+    with _lock:
+        if _change_batcher is None:
+            _change_batcher = ChangeBatcher(
+                max_changes=int(_env_float("AGAC_R53_BATCH_MAX", 100)),
+                linger=linger,
+                registry=obs_metrics.registry(),
+            )
+        return _change_batcher
+
+
+def _chain_stage_requeue() -> float:
+    """Stage-yield requeue delay for the interleaved accelerator
+    chain; 0 disables staging (one worker holds the item across the
+    whole create chain — reference parity)."""
+    if _env_float("AGAC_CHAIN_STAGES", 1.0) <= 0:
+        return 0.0
+    return _env_float("AGAC_CHAIN_STAGE_REQUEUE", 0.01)
+
+
+def pipeline_stats() -> dict:
+    """Pending-settle + batcher counters — the bench/healthz hook."""
+    with _lock:
+        table, batcher = _settle_table, _change_batcher
+    stats = {}
+    if table is not None:
+        stats["pending_settle"] = table.stats()
+    if batcher is not None:
+        stats["r53_batching"] = batcher.stats()
+    return stats
 
 
 def configure_api_health(
@@ -200,10 +288,19 @@ def _shared_discovery_cache() -> DiscoveryCache | None:
     if ttl <= 0:
         return None
     tracker = shared_health_tracker()
+    # 300 s: between full tag re-lists, snapshot reloads REUSE known
+    # accelerators' tags (local writes are write-through exact) and
+    # only new arns pay a live ListTagsForResource — the O(N) tag-read
+    # stall per reload is gone, at the cost of out-of-band TAG edits
+    # being detected within 300 s instead of the 30 s snapshot TTL
+    # (ISSUE 6 satellite; bound documented in docs/operations.md).
+    # <= 0 restores the legacy full re-read per reload.
+    tags_ttl = _env_float("AGAC_DISCOVERY_TAGS_TTL", 300.0)
     with _lock:
         if _discovery_cache is None:
             _discovery_cache = DiscoveryCache(
                 ttl=ttl,
+                tags_ttl=tags_ttl if tags_ttl > 0 else None,
                 # degraded mode: with the GA circuit open, serve the
                 # expired discovery snapshot stale rather than dispatch
                 # a doomed O(N) rescan (staleness bound: the outage)
@@ -328,10 +425,17 @@ def shared_fake_backend() -> FakeAWSBackend:
             # mutations survive a kill -9, which is what makes crash
             # drills against AGAC_CLOUD=fake meaningful
             state_path = os.environ.get("AGAC_FAKE_STATE", "")
+            # AGAC_FAKE_SETTLE=N makes accelerator create/update settle
+            # through N describe/list reads before DEPLOYED — the seam
+            # the kill-mid-settle process drill uses to exercise the
+            # pending-settle path against a real controller process
+            settle = int(os.environ.get("AGAC_FAKE_SETTLE", "0") or 0)
             if state_path:
-                _fake_backend = FileBackedFakeAWSBackend(state_path)
+                _fake_backend = FileBackedFakeAWSBackend(
+                    state_path, settle_describes=settle
+                )
             else:
-                _fake_backend = FakeAWSBackend()
+                _fake_backend = FakeAWSBackend(settle_describes=settle)
             _seed_from_environment(_fake_backend)
             _install_crash_plan(_fake_backend)
         return _fake_backend
@@ -400,6 +504,9 @@ def real_cloud_factory(region: str) -> AWSDriver:
         topology_cache=_shared_topology_cache(),
         record_cache=_shared_record_cache(),
         lb_coalescer=_shared_lb_coalescer(region),
+        settle_table=shared_settle_table(),
+        change_batcher=shared_change_batcher(),
+        stage_requeue=_chain_stage_requeue(),
         **_driver_timing(),
     )
     # expose every live cache's hit/miss counters as collection-time

@@ -565,6 +565,19 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         with self._lock:
             return list(self._accelerators.keys())
 
+    def chain_counts(self) -> tuple[int, int, int]:
+        """(accelerators, listeners, endpoint groups) — the complete-
+        chain convergence odometer.  With staged chains (ISSUE 6) an
+        accelerator exists passes before its listener/endpoint group
+        do, so counting accelerators alone would declare convergence
+        early."""
+        with self._lock:
+            return (
+                len(self._accelerators),
+                len(self._listener_parent),
+                len(self._endpoint_groups),
+            )
+
     # ------------------------------------------------------------------
     # GlobalAcceleratorAPI
     # ------------------------------------------------------------------
@@ -1120,6 +1133,10 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
     def all_accelerator_arns(self):
         self._reload_if_changed()
         return super().all_accelerator_arns()
+
+    def chain_counts(self):
+        self._reload_if_changed()
+        return super().chain_counts()
 
     def zone_id_by_name(self, name: str) -> Optional[str]:
         """Resolve a zone id by name — the assertion-side lookup a

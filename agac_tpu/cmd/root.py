@@ -172,6 +172,33 @@ def build_parser() -> argparse.ArgumentParser:
         "AGAC_LB_CACHE_TTL, AGAC_LB_BATCH_WINDOW.",
     )
 
+    controller.add_argument(
+        "--settle-poll-interval", type=float, default=None,
+        help="Tick period (seconds) of the pending-settle scheduler: "
+        "reconcile items parked on AWS wait states (accelerator "
+        "disable→DEPLOYED settles, Route53 change-batch commits, the "
+        "Route53 wait for the accelerator to exist) are re-checked in "
+        "coalesced reads and requeued when resolved, instead of each "
+        "holding a worker in a poll loop. Default 1 "
+        "(env AGAC_SETTLE_POLL_INTERVAL); 0 disables — reference-parity "
+        "blocking settle.",
+    )
+    controller.add_argument(
+        "--r53-batch-max", type=int, default=None,
+        help="Maximum changes per batched ChangeResourceRecordSets call "
+        "(the API accepts up to 1,000). Default 100 "
+        "(env AGAC_R53_BATCH_MAX).",
+    )
+    controller.add_argument(
+        "--r53-batch-linger", type=float, default=None,
+        help="Seconds the Route53 change batcher gathers co-submitted "
+        "record mutations for the same hosted zone into one multi-change "
+        "wire call. Default 0 = batching disabled (one call per "
+        "mutation, reference parity); 0.1-2 s recommended at fleet "
+        "scale (env AGAC_R53_BATCH_LINGER). See docs/operations.md "
+        "'Async mutation pipeline'.",
+    )
+
     webhook = sub.add_parser("webhook", help="Start webhook server")
     webhook.add_argument(
         "--tls-cert-file", default="",
@@ -263,12 +290,21 @@ def run_controller(args) -> int:
 
     from ..cloudprovider.aws.factory import (
         configure_api_health,
+        configure_pipeline,
         configure_read_plane,
         real_cloud_factory,
+        settle_poll_interval,
         shared_health_tracker,
+        shared_settle_table,
     )
 
     configure_read_plane(args.read_plane_ttl)
+    configure_pipeline(
+        settle_poll_interval=args.settle_poll_interval,
+        r53_batch_max=args.r53_batch_max,
+        r53_batch_linger=args.r53_batch_linger,
+    )
+    config.settle_poll_interval = settle_poll_interval()
     configure_api_health(
         window=args.api_health_window,
         failure_ratio=args.api_health_failure_ratio,
@@ -307,7 +343,8 @@ def run_controller(args) -> int:
 
     def run_manager(stop_event):
         manager.run(
-            client, config, stop_event, cloud_factory=real_cloud_factory, block=True
+            client, config, stop_event, cloud_factory=real_cloud_factory,
+            block=True, settle_table=shared_settle_table(),
         )
 
     if args.disable_leader_election:

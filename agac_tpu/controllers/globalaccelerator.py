@@ -350,8 +350,10 @@ class GlobalAcceleratorController:
                 arn, created, retry_after = cloud.ensure_global_accelerator_for_ingress(
                     obj, lb_ingress, self.cluster_name, lb_name, region
                 )
-            if retry_after > 0:
-                return Result(requeue=True, requeue_after=retry_after)
+            # event BEFORE the requeue check: in staged mode (ISSUE 6)
+            # the accelerator-create stage returns created=True WITH a
+            # stage requeue — the accelerator exists, so the event is
+            # due now, not after the chain tail lands
             if created:
                 self.recorder.eventf(
                     obj,
@@ -360,4 +362,6 @@ class GlobalAcceleratorController:
                     "Global Accelerator is created: %s",
                     arn,
                 )
+            if retry_after > 0:
+                return Result(requeue=True, requeue_after=retry_after)
         return Result()

@@ -75,6 +75,13 @@ class ControllerConfig:
     garbage_collector: GarbageCollectorConfig = field(
         default_factory=GarbageCollectorConfig
     )
+    # poll-tick period of the pending-settle scheduler (ISSUE 6): how
+    # often parked reconcile items (accelerator settles, change-batch
+    # commits, cross-controller waits) are re-checked in coalesced
+    # reads and requeued.  Only takes effect when a settle table is
+    # passed to Manager.run; the checks are cheap (one coalesced list
+    # + in-memory peeks), so 1 s keeps resolve latency ~1 tick.
+    settle_poll_interval: float = 1.0
 
 
 InitFunc = Callable[
@@ -126,6 +133,11 @@ class Manager:
         # the orphan GC sweeper (ISSUE 4), built by run() when its
         # interval is > 0; None = disabled (reference parity)
         self.gc: Optional[GarbageCollector] = None
+        # the pending-settle table (ISSUE 6) the run() caller wired;
+        # None = blocking-settle parity.  settle_tick() drives one
+        # scheduler round explicitly (tests/bench, the drift_tick
+        # pattern).
+        self.settle_table = None
 
     def run(
         self,
@@ -134,6 +146,7 @@ class Manager:
         stop: threading.Event,
         cloud_factory: Optional[CloudFactory] = None,
         block: bool = True,
+        settle_table=None,
     ) -> list[threading.Thread]:
         """Start every registered controller plus the shared informers;
         with ``block=True`` (the reference's ``wg.Wait()``) returns only
@@ -165,6 +178,17 @@ class Manager:
                 target=self.gc.run, args=(stop,), daemon=True,
                 name="garbage-collector",
             ).start()
+
+        if settle_table is not None and config.settle_poll_interval > 0:
+            # the async mutation pipeline's poll tick (ISSUE 6):
+            # re-checks every parked reconcile item in coalesced reads
+            # and requeues resolved/expired waits
+            from .reconcile.pending import SettleScheduler
+
+            self.settle_table = settle_table
+            SettleScheduler(
+                settle_table, interval=config.settle_poll_interval
+            ).start(stop)
 
         informer_factory.start(stop)
         api_health.start_worker_watchdog(stop, self.heartbeats)
@@ -245,6 +269,21 @@ class Manager:
             partial=report["partial"],
         )
         return enqueued
+
+    def settle_tick(self) -> dict:
+        """Drive ONE pending-settle poll round explicitly (tests and
+        the bench; same pattern as ``drift_tick``).  No-op when no
+        settle table is wired."""
+        if self.settle_table is None:
+            return {}
+        return self.settle_table.poll_once()
+
+    def settle_status(self) -> dict:
+        """Pending-settle depth/age counters for ``/healthz`` and
+        bench_detail."""
+        if self.settle_table is None:
+            return {"enabled": False}
+        return self.settle_table.stats()
 
     def gc_sweep(self) -> dict:
         """Drive ONE orphan-GC sweep explicitly (tests and the bench's

@@ -5,6 +5,14 @@ Capability parity with the reference's ``pkg/reconcile/reconcile.go``
 and the client-go ``util/workqueue`` machinery it builds on.
 """
 
+from .pending import (
+    SETTLE_FAILED,
+    SETTLE_PENDING,
+    SETTLE_READY,
+    PendingSettleTable,
+    SettleScheduler,
+    SettleWait,
+)
 from .result import Result
 from .workqueue import (
     BucketRateLimiter,
@@ -31,4 +39,10 @@ __all__ = [
     "controller_rate_limiter",
     "default_controller_rate_limiter",
     "process_next_work_item",
+    "PendingSettleTable",
+    "SettleScheduler",
+    "SettleWait",
+    "SETTLE_PENDING",
+    "SETTLE_READY",
+    "SETTLE_FAILED",
 ]

@@ -32,6 +32,7 @@ from .. import klog
 from ..cloudprovider.aws import health as api_health
 from ..errors import NoRetryError, NotFoundError, is_no_retry
 from ..observability import instruments, recorder, trace
+from .pending import SettleWait
 from .result import Result
 from .workqueue import RateLimitingQueue
 
@@ -178,7 +179,19 @@ def _reconcile_handler(
     reconcile_metrics = instruments.reconcile_instruments()
     reconcile_metrics.duration.labels(controller=controller).observe(elapsed)
 
-    if err is not None:
+    if isinstance(err, SettleWait) and err.table is not None:
+        # the async mutation pipeline (ISSUE 6): the handler reached an
+        # AWS wait state — park the item in the pending-settle table
+        # and free the worker; the poll-tick scheduler requeues it when
+        # the wait resolves (or its deadline expires).  Parking is not
+        # a failure: backoff state is untouched, and the sync-result
+        # hook sees a clean pass so failure streaks reset.
+        result = instruments.RESULT_PARKED
+        err.table.park(key, queue, err)
+        klog.v(2).infof("Parked %r: %s", key, err)
+        _notify(on_sync_result, key, None, 0, False)
+        err = None
+    elif err is not None:
         permanent = is_no_retry(err)
         if permanent:
             result = instruments.RESULT_PERMANENT_ERROR

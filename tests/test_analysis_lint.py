@@ -358,55 +358,57 @@ class TestDriftReadOutsideReadPlane:
 
 class TestUnboundedPollLoop:
     def test_sleepy_poll_without_deadline_fires_once(self):
-        v = only(
-            run(
-                """
-                def wait_settled(self, arn):
-                    while True:
-                        status = self.ga.describe_accelerator(arn).status
-                        if status == "DEPLOYED":
-                            return
-                        self._sleep(self._poll_interval)
-                """,
-                path="agac_tpu/cloudprovider/aws/bad.py",
-            ),
-            "unbounded-poll-loop",
+        # a describe+sleep settle loop is ALSO a blocking-settle
+        # violation since ISSUE 6 — the two rules layer: this one
+        # demands a deadline, the settle rule demands parking
+        violations = run(
+            """
+            def wait_settled(self, arn):
+                while True:
+                    status = self.ga.describe_accelerator(arn).status
+                    if status == "DEPLOYED":
+                        return
+                    self._sleep(self._poll_interval)
+            """,
+            path="agac_tpu/cloudprovider/aws/bad.py",
         )
+        assert sorted(v.rule for v in violations) == [
+            "blocking-settle-in-worker", "unbounded-poll-loop",
+        ], violations
+        v = next(v for v in violations if v.rule == "unbounded-poll-loop")
         assert "deadline" in v.message
 
     def test_deadline_consulting_loop_is_clean(self):
-        assert (
-            run(
-                """
-                def wait_settled(self, arn):
-                    deadline = monotonic() + self._poll_timeout
-                    while True:
-                        if self.ga.describe_accelerator(arn).status == "DEPLOYED":
-                            return
-                        if monotonic() >= deadline:
-                            raise TimeoutError(arn)
-                        self._sleep(self._poll_interval)
-                """,
-                path="agac_tpu/cloudprovider/aws/good.py",
-            )
-            == []
+        # clean for THIS rule; the settle rule still demands parking —
+        # a deadline bounds the wedge, it does not un-hold the worker
+        violations = run(
+            """
+            def wait_settled(self, arn):
+                deadline = monotonic() + self._poll_timeout
+                while True:
+                    if self.ga.describe_accelerator(arn).status == "DEPLOYED":
+                        return
+                    if monotonic() >= deadline:
+                        raise TimeoutError(arn)
+                    self._sleep(self._poll_interval)
+            """,
+            path="agac_tpu/cloudprovider/aws/good.py",
         )
+        assert [v.rule for v in violations] == ["blocking-settle-in-worker"]
 
     def test_health_plane_consulting_loop_is_clean(self):
-        assert (
-            run(
-                """
-                def wait_settled(self, arn):
-                    while True:
-                        if self.ga.describe_accelerator(arn).status == "DEPLOYED":
-                            return
-                        api_health.check_deadline("settle poll")
-                        self._sleep(self._poll_interval)
-                """,
-                path="agac_tpu/cloudprovider/aws/good.py",
-            )
-            == []
+        violations = run(
+            """
+            def wait_settled(self, arn):
+                while True:
+                    if self.ga.describe_accelerator(arn).status == "DEPLOYED":
+                        return
+                    api_health.check_deadline("settle poll")
+                    self._sleep(self._poll_interval)
+            """,
+            path="agac_tpu/cloudprovider/aws/good.py",
         )
+        assert [v.rule for v in violations] == ["blocking-settle-in-worker"]
 
     def test_sleepless_loop_is_clean(self):
         # a tight computational loop is not a poll
@@ -436,6 +438,103 @@ class TestUnboundedPollLoop:
             )
             == []
         )
+
+
+# ---------------------------------------------------------------------------
+# blocking-settle-in-worker
+# ---------------------------------------------------------------------------
+
+
+class TestBlockingSettleInWorker:
+    def test_settle_loop_in_driver_fires_once(self):
+        violations = run(
+            """
+            def _wait_for_deployed(self, arn):
+                while True:
+                    accelerator = self.ga.describe_accelerator(arn)
+                    if accelerator.status == "DEPLOYED":
+                        return
+                    api_health.check_deadline("settle")
+                    self._sleep(self._poll_interval)
+            """,
+            path="agac_tpu/cloudprovider/aws/bad.py",
+        )
+        # deadline-consulting, so unbounded-poll-loop stays quiet — the
+        # settle rule is the only one that fires: bounded or not, the
+        # loop HOLDS a worker that should have parked
+        v = only(violations, "blocking-settle-in-worker")
+        assert "SettleWait" in v.message
+
+    def test_settle_loop_in_controller_fires(self):
+        violations = run(
+            """
+            def process_thing(self, cloud, arn) -> "Result":
+                while cloud.ga.list_listeners(arn, 100, None):
+                    time.sleep(0.5)
+                return Result()
+            """,
+            path="agac_tpu/controllers/bad.py",
+        )
+        assert "blocking-settle-in-worker" in {v.rule for v in violations}
+
+    def test_pending_settle_scheduler_is_sanctioned(self):
+        # the poll-tick scheduler re-checks parked chains between
+        # sleeps BY DESIGN — reconcile/pending.py is the one home
+        assert (
+            run(
+                """
+                def loop(self):
+                    while not self._stop.wait(self.interval):
+                        ready = self._poller.list_accelerators(100, None)
+                        self._sleep(0.0)
+                """,
+                path="agac_tpu/reconcile/pending.py",
+            )
+            == []
+        )
+
+    def test_sleep_only_retry_loop_is_clean(self):
+        # sleeping without re-reading remote state is not a settle
+        # poll (bounding such loops is unbounded-poll-loop's business)
+        violations = run(
+            """
+            def retry(self):
+                while self._tries < 3:
+                    self._tries += 1
+                    self._sleep(0.1)
+            """,
+            path="agac_tpu/cloudprovider/aws/good.py",
+        )
+        assert "blocking-settle-in-worker" not in {v.rule for v in violations}
+
+    def test_read_only_drain_loop_is_clean(self):
+        # paging drains re-read without sleeping — not a settle poll
+        assert (
+            run(
+                """
+                def drain(self):
+                    token = None
+                    while True:
+                        page, token = self.ga.list_accelerators(100, token)
+                        if token is None:
+                            return page
+                """,
+                path="agac_tpu/cloudprovider/aws/good.py",
+            )
+            == []
+        )
+
+    def test_suppressed_parity_fallback_needs_justification(self):
+        src = """
+        def _blocking_settle_poll(self, arn):
+            while True:  # agac-lint: ignore[blocking-settle-in-worker]
+                if self.ga.describe_accelerator(arn).status == "DEPLOYED":
+                    return
+                api_health.check_deadline("settle")
+                self._sleep(1.0)
+        """
+        violations = run(src, path="agac_tpu/cloudprovider/aws/bad.py")
+        assert {v.rule for v in violations} == {"suppression-needs-justification"}
 
 
 # ---------------------------------------------------------------------------
@@ -644,6 +743,7 @@ def test_rule_registry_ships_the_documented_rules():
         "unguarded-optional-import",
         "drift-read-outside-read-plane",
         "unbounded-poll-loop",
+        "blocking-settle-in-worker",
         "delete-without-ownership-check",
         "unregistered-metric",
     }

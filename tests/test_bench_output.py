@@ -73,7 +73,10 @@ def test_last_stdout_line_is_compact_parseable_headline(bench_run):
     assert headline["value"] > 0
     assert headline["vs_baseline"] > 0
     # the scalars the round record should carry
-    for key in ("workers", "n_objects", "aws_calls_total", "sync_p99_s", "drift_tick"):
+    for key in (
+        "workers", "n_objects", "aws_calls_total", "sync_p99_s", "drift_tick",
+        "r53_cr_calls",
+    ):
         assert key in headline
     assert headline["detail_file"] == "bench_detail.json"
 
@@ -116,6 +119,20 @@ def test_detail_artifact_written_and_complete(bench_run, detail_path):
     # baseline ran the same mixed workload
     assert detail["baseline"]["n_bindings"] >= 1
     assert detail["baseline"]["n_ingresses"] >= 1
+    # the async mutation pipeline runs in the tuned phase only, with
+    # its own exported counter blocks (ISSUE 6)
+    assert detail["tuned"]["pipeline"] is True
+    assert detail["baseline"]["pipeline"] is False
+    settle = detail["pending_settle"]
+    for key in ("parked_total", "resolved_total", "expired_total", "depth"):
+        assert key in settle, f"pending_settle missing {key!r}"
+    assert settle["depth"] == 0, "items left parked after convergence"
+    batching = detail["r53_batching"]
+    for key in ("submissions", "wire_calls", "flushes", "batch_sizes"):
+        assert key in batching, f"r53_batching missing {key!r}"
+    assert batching["submissions"] >= 1
+    # batching can never INCREASE the wire-call count
+    assert batching["wire_calls"] <= batching["submissions"]
 
 
 def test_metrics_snapshot_scraped_per_phase(bench_run, detail_path):

@@ -792,3 +792,92 @@ class TestChaosFleet:
             assert len(creates) == n
         finally:
             stop.set()
+
+    def test_batched_record_changes_survive_invalid_change_batch_faults(self):
+        """FaultPlan chaos drill for the change batcher's partial-
+        failure fan-out (ISSUE 6 satellite): co-batched TXT+A pairs
+        whose multi-change wire call is rejected with
+        InvalidChangeBatch must degrade to per-item commits — no
+        co-batched record is poisoned by a neighbour's failure, the
+        cache-invalidate → requeue → re-read loop repairs the rest,
+        and the fleet converges to exactly the right record set."""
+        from agac_tpu.cloudprovider.aws.batcher import ChangeBatcher
+        from agac_tpu.cloudprovider.aws.cache import (
+            DiscoveryCache,
+            HostedZoneCache,
+            RecordSetCache,
+        )
+        from agac_tpu.reconcile import PendingSettleTable
+
+        n = 8
+        cluster = FakeCluster()
+        aws = FakeAWSBackend(quota_accelerators=n + 5)
+        zone = aws.add_hosted_zone("chaos.example.com")
+        plan = aws.install_fault_plan()
+        # every one of the first 4 ChangeResourceRecordSets calls —
+        # batched or split — is rejected: the first rejection forces a
+        # split, the next ones exercise split-retry failure fan-out
+        plan.fail("change_resource_record_sets", times=4, code="InvalidChangeBatch")
+
+        batcher = ChangeBatcher(max_changes=100, linger=0.15)
+        settle = PendingSettleTable()
+        plane = dict(
+            discovery_cache=DiscoveryCache(ttl=300.0),
+            zone_cache=HostedZoneCache(ttl=300.0),
+            record_cache=RecordSetCache(ttl=300.0),
+            change_batcher=batcher,
+            settle_table=settle,
+        )
+        seed_driver = AWSDriver(aws, aws, aws, **plane)
+        for i in range(n):
+            aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+            svc = make_lb_service(name=f"svc{i}", hostname=nlb_hostname(i))
+            # accelerators pre-exist (clean, exempt thread): the drill
+            # targets the Route53 batch wave, which then arrives as
+            # one co-batched cohort
+            seed_driver.ensure_global_accelerator_for_service(
+                svc, svc.status.load_balancer.ingress[0], "default",
+                f"lb{i}", NLB_REGION,
+            )
+            svc.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = (
+                f"app{i}.chaos.example.com"
+            )
+            cluster.create("Service", svc)
+
+        config = fleet_config(workers=4)
+        config.settle_poll_interval = 0.05
+        stop = threading.Event()
+        Manager(resync_period=0.3).run(
+            cluster, config, stop,
+            cloud_factory=lambda region: AWSDriver(
+                aws, aws, aws,
+                poll_interval=0.01, poll_timeout=2.0,
+                lb_not_active_retry=0.05, accelerator_missing_retry=0.05,
+                **plane,
+            ),
+            block=False,
+            settle_table=settle,
+        )
+        try:
+            def converged():
+                return len(aws.records_in_zone(zone.id)) == 2 * n
+
+            assert wait_until(converged, timeout=25.0), (
+                f"{len(aws.records_in_zone(zone.id))}/{2 * n} records after "
+                f"faults; batcher={batcher.stats()} settle={settle.stats()}"
+            )
+        finally:
+            stop.set()
+
+        # every pair landed, correctly paired — no record carries a
+        # co-batched neighbour's content
+        records = {(r.name, r.type): r for r in aws.records_in_zone(zone.id)}
+        for i in range(n):
+            name = f"app{i}.chaos.example.com."
+            assert (name, "A") in records and (name, "TXT") in records
+            assert f"service/default/svc{i}" in records[(name, "TXT")].resource_records[0].value
+        assert plan.faults_for("change_resource_record_sets") == 4
+        stats = batcher.stats()
+        assert stats["split_commits"] >= 1, (
+            f"no co-batched rejection was ever split: {stats}"
+        )

@@ -215,6 +215,12 @@ def test_converged_tick_stays_within_call_budget():
     assert total <= TICK_CALL_CEILING, (
         f"drift tick cost {total} AWS calls (ceiling {TICK_CALL_CEILING}): {by_op}"
     )
+    # the per-object tag-read hot spot stays dead (ISSUE 6 satellite):
+    # a converged tick reads tags from the discovery snapshot, never
+    # one ListTagsForResource per object — the cap admits only an
+    # unlucky snapshot refresh landing mid-tick (incremental, so it
+    # re-reads new arns only; a full O(N) re-list here would blow this)
+    assert by_op.get("ListTagsForResource", 0) <= 2, by_op
     # and the tick genuinely VERIFIED, not just skipped reads: every
     # accelerator chain tail re-read, every zone re-listed, the
     # binding's endpoint group re-described

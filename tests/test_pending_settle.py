@@ -1,0 +1,319 @@
+"""Unit tier for the async mutation pipeline's pending-settle table
+(ISSUE 6, ``agac_tpu/reconcile/pending.py``): parking, coalesced
+group polls, deadline expiry and circuit-open semantics — all on
+FakeClock — plus the reconcile-loop and driver integrations (a worker
+that parks is freed immediately; a parked teardown resumes through the
+scheduler's coalesced describes and completes the delete)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.cache import DiscoveryCache
+from agac_tpu.cloudprovider.aws.health import CircuitOpenError
+from agac_tpu.reconcile import (
+    SETTLE_FAILED,
+    SETTLE_READY,
+    PendingSettleTable,
+    RateLimitingQueue,
+    Result,
+    SettleWait,
+    process_next_work_item,
+)
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+
+
+class FakeClock:
+    def __init__(self, now: float = 100.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+class RecorderQueue:
+    """Duck-typed queue capturing the table's requeue decisions."""
+
+    def __init__(self):
+        self.added: list[str] = []
+        self.rate_limited: list[str] = []
+        self.forgotten: list[str] = []
+
+    def add(self, key):
+        self.added.append(key)
+
+    def add_rate_limited(self, key):
+        self.rate_limited.append(key)
+
+    def forget(self, key):
+        self.forgotten.append(key)
+
+
+def wait(group: str, token, timeout: float = 30.0, table=None) -> SettleWait:
+    return SettleWait(group, token, table=table, timeout=timeout)
+
+
+class TestPendingSettleTable:
+    def test_resolved_wait_requeues_with_forget(self):
+        clock = FakeClock()
+        table = PendingSettleTable(clock=clock)
+        queue = RecorderQueue()
+        table.register_poller("g", lambda tokens: {t: SETTLE_READY for t in tokens})
+        table.park("ns/a", queue, wait("g", "arn-1"))
+        assert table.depth() == 1
+        report = table.poll_once()
+        assert report["resolved"] == 1
+        assert queue.added == ["ns/a"]
+        assert queue.forgotten == ["ns/a"]  # parking was not a failure
+        assert queue.rate_limited == []
+        assert table.depth() == 0
+
+    def test_unresolved_wait_stays_parked(self):
+        table = PendingSettleTable(clock=FakeClock())
+        queue = RecorderQueue()
+        table.register_poller("g", lambda tokens: {})
+        table.park("ns/a", queue, wait("g", "arn-1"))
+        report = table.poll_once()
+        assert report["pending"] == 1 and table.depth() == 1
+        assert queue.added == [] and queue.rate_limited == []
+
+    def test_failed_wait_requeues_rate_limited(self):
+        table = PendingSettleTable(clock=FakeClock())
+        queue = RecorderQueue()
+        table.register_poller("g", lambda tokens: {t: SETTLE_FAILED for t in tokens})
+        table.park("ns/a", queue, wait("g", "arn-1"))
+        table.poll_once()
+        # a failing wait must back off, never livelock at tick frequency
+        assert queue.rate_limited == ["ns/a"] and queue.added == []
+        assert table.failed_total == 1
+
+    def test_deadline_expiry_requeues_rate_limited(self):
+        clock = FakeClock()
+        table = PendingSettleTable(clock=clock)
+        queue = RecorderQueue()
+        table.register_poller("g", lambda tokens: {})
+        table.park("ns/a", queue, wait("g", "arn-1", timeout=10.0))
+        clock.advance(9.9)
+        assert table.poll_once()["expired"] == 0
+        clock.advance(0.2)
+        report = table.poll_once()
+        assert report["expired"] == 1
+        assert queue.rate_limited == ["ns/a"]
+        assert table.depth() == 0 and table.expired_total == 1
+
+    def test_circuit_open_skips_group_but_deadlines_still_run(self):
+        """The health-plane integration: a poller whose coalesced read
+        is shed by an open circuit skips the group — parked items age
+        (no drop, no spin) and their deadlines keep running, so an
+        outage degrades to the legacy requeue cadence."""
+        clock = FakeClock()
+        table = PendingSettleTable(clock=clock)
+        queue = RecorderQueue()
+
+        def open_circuit(tokens):
+            raise CircuitOpenError("globalaccelerator", 5.0)
+
+        table.register_poller("g", open_circuit)
+        table.park("ns/a", queue, wait("g", "arn-1", timeout=20.0))
+        report = table.poll_once()
+        assert report["circuit_skipped"] == ["g"]
+        assert table.depth() == 1 and table.circuit_skips == 1
+        assert queue.added == [] and queue.rate_limited == []
+        # the deadline is checked BEFORE the poller, so expiry frees
+        # the item even while the circuit stays open
+        clock.advance(25.0)
+        assert table.poll_once()["expired"] == 1
+        assert queue.rate_limited == ["ns/a"]
+
+    def test_group_poll_is_coalesced(self):
+        table = PendingSettleTable(clock=FakeClock())
+        queue = RecorderQueue()
+        calls = []
+
+        def poller(tokens):
+            calls.append(list(tokens))
+            return {t: SETTLE_READY for t in tokens}
+
+        table.register_poller("g", poller)
+        for i in range(5):
+            table.park(f"ns/obj{i}", queue, wait("g", f"arn-{i}"))
+        table.poll_once()
+        assert len(calls) == 1, "one coalesced poll for the whole group"
+        assert sorted(calls[0]) == [f"arn-{i}" for i in range(5)]
+        assert sorted(queue.added) == [f"ns/obj{i}" for i in range(5)]
+
+    def test_reparking_replaces_entry(self):
+        clock = FakeClock()
+        table = PendingSettleTable(clock=clock)
+        queue = RecorderQueue()
+        table.register_poller("g", lambda tokens: {})
+        table.park("ns/a", queue, wait("g", "arn-old", timeout=5.0))
+        clock.advance(4.0)
+        table.park("ns/a", queue, wait("g", "arn-new", timeout=5.0))
+        assert table.depth() == 1
+        clock.advance(2.0)  # past the OLD deadline, not the new one
+        assert table.poll_once()["expired"] == 0
+
+    def test_pollerless_group_holds_until_deadline(self):
+        clock = FakeClock()
+        table = PendingSettleTable(clock=clock)
+        queue = RecorderQueue()
+        table.park("ns/a", queue, wait("unknown-group", "t", timeout=3.0))
+        assert table.poll_once()["pending"] == 1
+        clock.advance(3.1)
+        assert table.poll_once()["expired"] == 1
+
+    def test_oldest_age_and_stats(self):
+        clock = FakeClock()
+        table = PendingSettleTable(clock=clock)
+        queue = RecorderQueue()
+        table.park("ns/a", queue, wait("g", "t1"))
+        clock.advance(7.0)
+        table.park("ns/b", queue, wait("g", "t2"))
+        assert table.oldest_age() == pytest.approx(7.0)
+        stats = table.stats()
+        assert stats["depth"] == 2 and stats["parked_total"] == 2
+        assert stats["depth_by_group"] == {"g": 2}
+
+
+class TestReconcileLoopParking:
+    def test_settle_wait_parks_item_and_frees_worker(self):
+        """A process func that raises SettleWait must not be treated
+        as an error: the item lands in the table (no backoff growth,
+        no rate-limited requeue) and the worker finishes the pass."""
+        table = PendingSettleTable(clock=FakeClock())
+        queue = RateLimitingQueue(name="test-park")
+        queue.add("default/svc")
+        outcomes = []
+
+        def process(obj):
+            raise SettleWait("g", "token", table=table)
+
+        assert process_next_work_item(
+            queue,
+            key_to_obj=lambda key: object(),
+            process_delete=lambda key: Result(),
+            process_create_or_update=process,
+            on_sync_result=lambda key, err, requeues, permanent: outcomes.append(
+                (key, err, permanent)
+            ),
+        )
+        assert table.depth() == 1
+        assert len(queue) == 0, "parked item must not be re-queued"
+        assert queue.num_requeues("default/svc") == 0, "parking is not a failure"
+        # the sync-result hook saw a clean pass (failure streaks reset)
+        assert outcomes == [("default/svc", None, False)]
+        # resolution puts the item back on the very queue it came from
+        table.register_poller("g", lambda tokens: {t: SETTLE_READY for t in tokens})
+        table.poll_once()
+        item, shutdown = queue.get(timeout=1.0)
+        assert item == "default/svc" and not shutdown
+        queue.shutdown()
+
+    def test_settle_wait_without_table_is_an_ordinary_error(self):
+        """A SettleWait that escapes a driver with no table wired (a
+        misconfiguration) must fall back to the retry policy, never
+        vanish."""
+        queue = RateLimitingQueue(name="test-no-table")
+        queue.add("default/svc")
+
+        def process(obj):
+            raise SettleWait("g", "token", table=None)
+
+        assert process_next_work_item(
+            queue,
+            key_to_obj=lambda key: object(),
+            process_delete=lambda key: Result(),
+            process_create_or_update=process,
+        )
+        assert queue.num_requeues("default/svc") == 1
+        queue.shutdown()
+
+
+class TestDriverSettleParking:
+    def _driver(self, backend, table, **kwargs):
+        return AWSDriver(
+            backend, backend, backend,
+            poll_interval=0.001, poll_timeout=5.0,
+            settle_table=table, **kwargs,
+        )
+
+    def test_teardown_parks_and_resumes_through_coalesced_poll(self):
+        backend = FakeAWSBackend(settle_describes=4)
+        backend.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        table = PendingSettleTable(clock=FakeClock())
+        driver = self._driver(backend, table)
+        svc = make_lb_service()
+        arn, _, _ = driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "c", NLB_NAME, NLB_REGION
+        )
+        queue = RecorderQueue()
+
+        def one_pass():
+            try:
+                driver.cleanup_global_accelerator(arn)
+                return True
+            except SettleWait as err:
+                err.table.park("default/svc", queue, err)
+                return False
+
+        assert not one_pass(), "disable leaves IN_PROGRESS: must park"
+        assert table.depth() == 1
+        describes_before = sum(
+            1 for c in backend.calls if c[0] == "DescribeAccelerator"
+        )
+        # the scheduler's coalesced poll settles the fake (each
+        # ListAccelerators counts as one settle read) and resolves
+        for _ in range(10):
+            table.poll_once()
+            if queue.added:
+                break
+        assert queue.added == ["default/svc"], "settle resolution requeues"
+        # the poll issued NO per-item describes — only coalesced lists
+        assert describes_before == sum(
+            1 for c in backend.calls if c[0] == "DescribeAccelerator"
+        )
+        assert one_pass(), "resumed teardown completes"
+        assert backend.all_accelerator_arns() == []
+        # the resume did NOT re-disable — a second UpdateAccelerator
+        # would reset the fake's settle clock and livelock the park
+        disables = [c for c in backend.calls if c[0] == "UpdateAccelerator"]
+        assert len(disables) == 1
+
+    def test_route53_parks_on_missing_accelerator_and_resolves_on_create(self):
+        backend = FakeAWSBackend()
+        backend.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        backend.add_hosted_zone("example.com")
+        table = PendingSettleTable(clock=FakeClock())
+        discovery = DiscoveryCache(ttl=300.0)
+        driver = self._driver(backend, table, discovery_cache=discovery)
+        svc = make_lb_service()
+        lb_ingress = svc.status.load_balancer.ingress[0]
+        queue = RecorderQueue()
+
+        with pytest.raises(SettleWait) as exc:
+            driver.ensure_route53_for_service(
+                svc, lb_ingress, ["app.example.com"], "c"
+            )
+        exc.value.table.park("default/svc", queue, exc.value)
+        # nothing resolves while the accelerator does not exist
+        table.poll_once()
+        assert queue.added == []
+        # the GA controller converges: its create write-through lands
+        # in the discovery snapshot the poller peeks
+        driver.ensure_global_accelerator_for_service(
+            svc, lb_ingress, "c", NLB_NAME, NLB_REGION
+        )
+        table.poll_once()
+        assert queue.added == ["default/svc"]
+        created, retry = driver.ensure_route53_for_service(
+            svc, lb_ingress, ["app.example.com"], "c"
+        )
+        assert created and retry == 0

@@ -176,6 +176,7 @@ class Drill:
         crash: str = "",
         args: tuple = (),
         leader_election: bool = False,
+        extra_env: dict | None = None,
     ) -> subprocess.Popen:
         env = dict(
             os.environ,
@@ -185,6 +186,7 @@ class Drill:
             POD_NAMESPACE="kube-system",
             **FAST_LEASE_ENV,
         )
+        env.update(extra_env or {})
         if self.zones:
             env["AGAC_FAKE_ZONES"] = self.zones
         if crash:
@@ -347,6 +349,61 @@ class TestKillRecoveryDrills:
                     f"sweeper did not mop up: {drill.chain()}, "
                     f"records={drill.record_names('example.com')}\n{_dump(gen2)}"
                 )
+                assert drill.terminate(gen2) == 0
+            finally:
+                drill.stop_all()
+
+    def test_kill_mid_settle_pending_table_rebuilt_from_requeue(self, tmp_path):
+        """kill -9 while a teardown is PARKED in the pending-settle
+        table (ISSUE 6): the accelerator is disabled and still
+        IN_PROGRESS in the durable state, the Service is gone, and the
+        in-memory pending table died with the process — deliberately
+        unpersisted.  The successor re-derives everything from requeue:
+        its GC sweeper re-runs the teardown, hits the same wait state,
+        and converges once the settle resolves — without ever
+        re-disabling (which would reset the settle clock forever)."""
+        settle_env = {"AGAC_FAKE_SETTLE": "5"}
+        with TestApiServer() as server:
+            drill = Drill(tmp_path, server)
+            try:
+                # gen1: settle scheduler effectively dormant, so the
+                # parked teardown stays parked — a stable kill window
+                gen1 = drill.start(
+                    extra_env={**settle_env, "AGAC_SETTLE_POLL_INTERVAL": "600"},
+                )
+                drill.client.create("Service", make_lb_service(name="drill"))
+                assert wait_until(drill.chain_complete, timeout=30.0), _dump(gen1)
+
+                drill.client.delete("Service", "default", "drill")
+
+                def parked_mid_settle():
+                    aws = drill.aws()
+                    arns = aws.all_accelerator_arns()
+                    if len(arns) != 1:
+                        return False
+                    _, listeners, _ = drill.chain()
+                    if listeners:
+                        return False  # teardown not past the listener yet
+                    accelerator = aws.describe_accelerator(arns[0])
+                    return not accelerator.enabled
+
+                assert wait_until(parked_mid_settle, timeout=30.0), _dump(gen1)
+                gen1.kill()  # the real SIGKILL: the pending table dies here
+                gen1.wait(10)
+                arns = drill.aws().all_accelerator_arns()
+                assert len(arns) == 1, "disabled accelerator must still be leaked"
+
+                # gen2: fast settle ticks + the GC sweeper (the only
+                # path that can re-enqueue a teardown whose delete
+                # event died) — the wait is re-derived and re-parked
+                # from requeue, never from persisted table state
+                gen2 = drill.start(
+                    args=GC_ARGS,
+                    extra_env={**settle_env, "AGAC_SETTLE_POLL_INTERVAL": "0.05"},
+                )
+                assert wait_until(
+                    lambda: drill.aws().all_accelerator_arns() == [], timeout=30.0
+                ), f"settled teardown not finished: {drill.chain()}\n{_dump(gen2)}"
                 assert drill.terminate(gen2) == 0
             finally:
                 drill.stop_all()

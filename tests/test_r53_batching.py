@@ -1,0 +1,416 @@
+"""Unit + integration tier for the per-zone Route53 change batcher
+(ISSUE 6, ``agac_tpu/cloudprovider/aws/batcher.py``): coalescing
+across threads, atomic-pair integrity, partial-failure fan-out (one
+rejected change fails ONLY the owning items, invalidates the zone
+cache exactly once, and never poisons co-batched records), the async
+ticket/park path, and the tier-1 wire-call regression at bench N=6
+scale (``change_resource_record_sets`` ≤ ceil(N·changes/batch_max) +
+slack instead of one call per record)."""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+import pytest
+
+from agac_tpu import apis
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.batcher import ChangeBatcher
+from agac_tpu.cloudprovider.aws.cache import (
+    DiscoveryCache,
+    HostedZoneCache,
+    RecordSetCache,
+)
+from agac_tpu.cloudprovider.aws.driver import _poll_batch_tickets
+from agac_tpu.cloudprovider.aws.errors import AWSAPIError
+from agac_tpu.cloudprovider.aws.types import (
+    CHANGE_ACTION_CREATE,
+    CHANGE_ACTION_UPSERT,
+    Change,
+    ResourceRecord,
+    ResourceRecordSet,
+)
+from agac_tpu.controllers import (
+    EndpointGroupBindingConfig,
+    GlobalAcceleratorConfig,
+    Route53Config,
+)
+from agac_tpu.cluster import FakeCluster
+from agac_tpu.manager import ControllerConfig, Manager
+from agac_tpu.reconcile import PendingSettleTable, SETTLE_FAILED, SETTLE_READY
+
+from .fixtures import NLB_REGION, make_lb_service
+
+
+def txt_change(name: str, value: str = '"owner"', action: str = CHANGE_ACTION_CREATE) -> Change:
+    return Change(
+        action,
+        ResourceRecordSet(
+            name=name, type="TXT", ttl=300,
+            resource_records=[ResourceRecord(value)],
+        ),
+    )
+
+
+class RecordingBackend:
+    """Commit sink capturing (zone, changes) per wire call; scripted
+    failures by call index or by a predicate on the merged changes."""
+
+    def __init__(self):
+        self.calls: list[tuple[str, list[Change]]] = []
+        self.fail_when = None  # fn(zone, changes) -> Exception | None
+        self.lock = threading.Lock()
+
+    def commit(self, zone_id, changes):
+        with self.lock:
+            self.calls.append((zone_id, list(changes)))
+        if self.fail_when is not None:
+            err = self.fail_when(zone_id, changes)
+            if err is not None:
+                raise err
+
+
+class TestChangeBatcherUnit:
+    def test_concurrent_submissions_coalesce_into_one_wire_call(self):
+        backend = RecordingBackend()
+        batcher = ChangeBatcher(max_changes=100, linger=0.15)
+        results = []
+
+        def submit(i):
+            batcher.submit(
+                "/hostedzone/Z1",
+                [txt_change(f"r{i}.example.com"), txt_change(f"a{i}.example.com")],
+                backend.commit,
+            )
+            results.append(i)
+
+        threads = [threading.Thread(target=submit, args=(i,)) for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert len(results) == 5
+        assert len(backend.calls) == 1, "five submissions, ONE wire call"
+        zone, changes = backend.calls[0]
+        assert zone == "/hostedzone/Z1" and len(changes) == 10
+        stats = batcher.stats()
+        assert stats["wire_calls"] == 1 and stats["submissions"] == 5
+        assert stats["flushes"]["linger"] == 1
+
+    def test_zones_batch_independently(self):
+        backend = RecordingBackend()
+        batcher = ChangeBatcher(max_changes=100, linger=0.1)
+        threads = [
+            threading.Thread(
+                target=batcher.submit,
+                args=(f"/hostedzone/Z{i % 2}", [txt_change(f"r{i}.ex.com")], backend.commit),
+            )
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert len(backend.calls) == 2
+        assert {zone for zone, _ in backend.calls} == {
+            "/hostedzone/Z0", "/hostedzone/Z1"
+        }
+
+    def test_full_batch_cuts_linger_short(self):
+        backend = RecordingBackend()
+        batcher = ChangeBatcher(max_changes=4, linger=30.0)  # linger would hang
+        threads = [
+            threading.Thread(
+                target=batcher.submit,
+                args=("/hostedzone/Z1",
+                      [txt_change(f"r{i}.ex.com"), txt_change(f"a{i}.ex.com")],
+                      backend.commit),
+            )
+            for i in range(2)
+        ]
+        start = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert time.monotonic() - start < 10, "full batch must not wait out linger"
+        assert len(backend.calls) == 1 and len(backend.calls[0][1]) == 4
+        assert batcher.stats()["flushes"]["full"] == 1
+
+    def test_submission_never_splits_across_wire_calls(self):
+        """The atomic TXT+A pair: a submission that does not fit the
+        forming batch starts a new one instead of being split."""
+        backend = RecordingBackend()
+        batcher = ChangeBatcher(max_changes=3, linger=0.1)
+        threads = [
+            threading.Thread(
+                target=batcher.submit,
+                args=("/hostedzone/Z1",
+                      [txt_change(f"r{i}.ex.com"), txt_change(f"a{i}.ex.com")],
+                      backend.commit),
+            )
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert len(backend.calls) == 2
+        for _, changes in backend.calls:
+            assert len(changes) == 2, "each pair intact in its own call"
+
+    def test_partial_failure_fans_out_to_owning_item_only(self):
+        """InvalidChangeBatch on a co-batched call: the batch is
+        atomic at AWS, so the batcher degrades to per-submission
+        commits — healthy submissions land, the owning item alone gets
+        the error, and the zone cache is invalidated exactly once."""
+        backend = RecordingBackend()
+        invalidations = []
+        folded = []
+
+        def fail_bad_record(zone, changes):
+            if any("bad." in c.record_set.name for c in changes):
+                return AWSAPIError("InvalidChangeBatch", "record exists")
+            return None
+
+        backend.fail_when = fail_bad_record
+        batcher = ChangeBatcher(max_changes=100, linger=0.15)
+        errors: dict[str, Exception | None] = {}
+
+        def submit(name):
+            try:
+                batcher.submit(
+                    "/hostedzone/Z1", [txt_change(f"{name}.ex.com")],
+                    backend.commit,
+                    fold=lambda zone, changes: folded.append(list(changes)),
+                    invalidate=invalidations.append,
+                )
+                errors[name] = None
+            except Exception as err:
+                errors[name] = err
+
+        threads = [
+            threading.Thread(target=submit, args=(name,))
+            for name in ("good1", "bad", "good2")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert errors["good1"] is None and errors["good2"] is None
+        assert isinstance(errors["bad"], AWSAPIError)
+        assert errors["bad"].code == "InvalidChangeBatch"
+        # the zone snapshot was dropped ONCE for the whole batch
+        assert invalidations == ["/hostedzone/Z1"]
+        # write-through folded only the committed sub-batches
+        committed = {c.record_set.name for changes in folded for c in changes}
+        assert committed == {"good1.ex.com", "good2.ex.com"}
+        stats = batcher.stats()
+        assert stats["split_commits"] == 1
+        assert stats["flushes"]["split"] == 2  # two healthy singles landed
+
+    def test_whole_batch_failure_fails_every_owner_without_invalidate(self):
+        backend = RecordingBackend()
+        backend.fail_when = lambda zone, changes: AWSAPIError(
+            "ThrottlingException", "slow down"
+        )
+        invalidations = []
+        batcher = ChangeBatcher(max_changes=100, linger=0.1)
+        errors = []
+
+        def submit(i):
+            try:
+                batcher.submit(
+                    "/hostedzone/Z1", [txt_change(f"r{i}.ex.com")],
+                    backend.commit, invalidate=invalidations.append,
+                )
+            except Exception as err:
+                errors.append(err)
+
+        threads = [threading.Thread(target=submit, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert len(errors) == 3
+        assert all(e.code == "ThrottlingException" for e in errors)
+        # a throttle says nothing about snapshot truth: no invalidate,
+        # and no split retries hammering the throttled service
+        assert invalidations == []
+        assert len(backend.calls) == 1
+
+    def test_async_ticket_resolves_and_polls(self):
+        backend = RecordingBackend()
+        batcher = ChangeBatcher(max_changes=100, linger=0.1)
+        tickets = {}
+        lead = threading.Thread(
+            target=lambda: tickets.__setitem__(
+                "lead",
+                batcher.submit_async(
+                    "/hostedzone/Z1", [txt_change("lead.ex.com")], backend.commit
+                ),
+            ),
+        )
+        lead.start()
+        time.sleep(0.02)  # the leader is lingering: join its batch
+        joiner = batcher.submit_async(
+            "/hostedzone/Z1", [txt_change("join.ex.com")], backend.commit
+        )
+        assert not joiner.done(), "joiner ticket pends until the leader commits"
+        assert _poll_batch_tickets([joiner]) == {}
+        lead.join(5)
+        assert joiner.wait(5)
+        assert _poll_batch_tickets([joiner]) == {joiner: SETTLE_READY}
+        assert tickets["lead"].state() == "ready"
+        assert len(backend.calls) == 1 and len(backend.calls[0][1]) == 2
+
+    def test_failed_ticket_polls_failed(self):
+        backend = RecordingBackend()
+        backend.fail_when = lambda zone, changes: AWSAPIError(
+            "InvalidChangeBatch", "nope"
+        )
+        batcher = ChangeBatcher(max_changes=100, linger=0.0)
+        ticket = batcher.submit_async(
+            "/hostedzone/Z1", [txt_change("r.ex.com")], backend.commit
+        )
+        assert ticket.done() and ticket.state() == "failed"
+        assert _poll_batch_tickets([ticket]) == {ticket: SETTLE_FAILED}
+
+
+class TestDriverBatching:
+    def _driver(self, backend, batcher, **kwargs):
+        return AWSDriver(backend, backend, backend, change_batcher=batcher, **kwargs)
+
+    def test_concurrent_ensures_share_one_wire_call_with_write_through(self):
+        backend = FakeAWSBackend(quota_accelerators=10)
+        zone = backend.add_hosted_zone("ex.com")
+        batcher = ChangeBatcher(max_changes=100, linger=0.15)
+        records = RecordSetCache(ttl=300.0)
+        driver = self._driver(backend, batcher, record_cache=records)
+        for i in range(2):
+            lb = f"lb{i}"
+            host = f"bench{i}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+            backend.add_load_balancer(lb, NLB_REGION, host)
+            svc = make_lb_service(name=f"svc{i}", hostname=host)
+            driver.ensure_global_accelerator_for_service(
+                svc, svc.status.load_balancer.ingress[0], "c", lb, NLB_REGION
+            )
+
+        def ensure(i):
+            host = f"bench{i}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+            svc = make_lb_service(name=f"svc{i}", hostname=host)
+            created, retry = driver.ensure_route53_for_service(
+                svc, svc.status.load_balancer.ingress[0],
+                [f"app{i}.ex.com"], "c",
+            )
+            assert created and retry == 0
+
+        threads = [threading.Thread(target=ensure, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        wire_calls = [c for c in backend.calls if c[0] == "ChangeResourceRecordSets"]
+        assert len(wire_calls) == 1, "two TXT+A pairs, one wire call"
+        names = {(r.name, r.type) for r in backend.records_in_zone(zone.id)}
+        assert names == {
+            ("app0.ex.com.", "TXT"), ("app0.ex.com.", "A"),
+            ("app1.ex.com.", "TXT"), ("app1.ex.com.", "A"),
+        }
+        # write-through: the committed batch is visible in the zone
+        # snapshot without another wire read
+        lists_before = sum(
+            1 for c in backend.calls if c[0] == "ListResourceRecordSets"
+        )
+        snapshot = driver._list_record_sets(zone.id)
+        assert {(r.name, r.type) for r in snapshot} >= names
+        assert lists_before == sum(
+            1 for c in backend.calls if c[0] == "ListResourceRecordSets"
+        )
+
+
+def test_manager_fleet_wire_call_regression_at_bench_scale():
+    """The tier-1 regression the bench proves at N=1,200: at bench N=6
+    scale, a converging fleet's ``change_resource_record_sets`` wire
+    calls stay ≤ ceil(total_changes / batch_max) + slack — instead of
+    the one-call-per-record legacy (6 calls for 6 services).  Items
+    enqueue together and their accelerators pre-exist, so the ensures
+    land inside one linger window per zone."""
+    n = 6
+    aws = FakeAWSBackend(quota_accelerators=n + 5)
+    cluster = FakeCluster()
+    zone = aws.add_hosted_zone("budget.example.com")
+    batcher = ChangeBatcher(max_changes=100, linger=0.25)
+    settle = PendingSettleTable()
+    plane = dict(
+        discovery_cache=DiscoveryCache(ttl=300.0),
+        zone_cache=HostedZoneCache(ttl=300.0),
+        record_cache=RecordSetCache(ttl=300.0),
+        change_batcher=batcher,
+        settle_table=settle,
+    )
+    driver = AWSDriver(aws, aws, aws, **plane)
+    hostnames = []
+    for i in range(n):
+        lb = f"lb{i}"
+        host = f"bench{i}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+        aws.add_load_balancer(lb, NLB_REGION, host)
+        svc = make_lb_service(name=f"svc{i}", hostname=host)
+        # the accelerators pre-exist: the measured phase is the
+        # Route53 wave, arriving together like a converged GA cohort
+        driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "default", lb, NLB_REGION
+        )
+        svc.metadata.annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = (
+            f"svc{i}.budget.example.com"
+        )
+        hostnames.append(f"svc{i}.budget.example.com")
+        cluster.create("Service", svc)
+
+    before = sum(1 for c in aws.calls if c[0] == "ChangeResourceRecordSets")
+    stop = threading.Event()
+    config = ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(
+            workers=4, queue_qps=1000.0, queue_burst=1000
+        ),
+        route53=Route53Config(workers=4, queue_qps=1000.0, queue_burst=1000),
+        endpoint_group_binding=EndpointGroupBindingConfig(workers=1),
+        settle_poll_interval=0.05,
+    )
+    manager = Manager(resync_period=10_000.0)
+    manager.run(
+        cluster, config, stop,
+        cloud_factory=lambda region: AWSDriver(
+            aws, aws, aws, accelerator_missing_retry=0.1, **plane
+        ),
+        block=False,
+        settle_table=settle,
+    )
+    try:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            names = {(r.name, r.type) for r in aws.records_in_zone(zone.id)}
+            if len(names) == 2 * n:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail(
+                f"fleet did not converge: {len(aws.records_in_zone(zone.id))}/{2*n} records"
+            )
+    finally:
+        stop.set()
+    wire_calls = (
+        sum(1 for c in aws.calls if c[0] == "ChangeResourceRecordSets") - before
+    )
+    # 6 pairs = 12 changes; batch_max 100 → ceil(12/100) = 1 ideal;
+    # slack 2 admits worker-interleaving generations
+    ceiling = math.ceil(2 * n / 100) + 2
+    assert wire_calls <= ceiling, (
+        f"{wire_calls} ChangeResourceRecordSets calls for {n} services "
+        f"(ceiling {ceiling}); batching regressed toward one-call-per-record"
+    )
+    stats = batcher.stats()
+    assert stats["wire_calls"] == wire_calls
+    assert max(stats["batch_sizes"]) >= 4, "no multi-item batch ever formed"
