@@ -40,6 +40,13 @@ stack — the classes ruff's pyflakes-tier cannot express:
   never reaches ``/metrics`` (the exact private-counter drift the
   observability plane deletes), and a computed label set is how a
   key/error-text cardinality explosion melts the scrape.
+- ``unseamed-clock`` — direct ``time.time()`` / ``time.monotonic()`` /
+  ``time.sleep()`` / ``threading.Timer`` outside the clock seam
+  (``agac_tpu/clockseam.py``), the sim runtime and the sanctioned
+  real-I/O edges (ISSUE 7): one raw wall-clock read in a reconcile
+  path silently de-virtualizes the deterministic simulation runtime —
+  scenarios stop replaying byte-identically and the 7-day virtual
+  soak quietly waits on the real clock.
 - ``delete-without-ownership-check`` — teardown calls reachable from
   the GC sweeper (``controllers/garbagecollector.py``) must flow
   through an ownership-verification helper (ISSUE 4): the sweeper is
@@ -780,6 +787,114 @@ def check_unregistered_metric(tree: ast.Module, ctx: LintContext) -> Iterator[Vi
                 "never do); a dynamic label set is an unbounded-cardinality "
                 "risk",
             )
+
+
+# ---------------------------------------------------------------------------
+# unseamed-clock
+# ---------------------------------------------------------------------------
+
+# the wall-clock reads/sleeps the seam routes; time.strftime/gmtime
+# (pure formatting) stay unflagged
+_CLOCK_ATTRS = frozenset({"time", "monotonic", "sleep", "time_ns", "perf_counter"})
+
+_CLOCK_SEAM_SUGGESTION = {
+    "time": "clockseam.time()",
+    "time_ns": "clockseam.time()",
+    "monotonic": "clockseam.monotonic()",
+    "perf_counter": "clockseam.monotonic()",
+    "sleep": "clockseam.sleep()",
+}
+
+# modules whose business IS real time: the seam itself, the sim
+# runtime built on it, and the real-I/O edges where wall clock is
+# semantically required (OAuth token expiry over real HTTP, SigV4
+# request signing, real-AWS retry pacing, the subprocess apiserver
+# test harness) — virtual time there would sign invalid requests or
+# turn real-socket timeouts into hangs
+_CLOCK_SANCTIONED = (
+    "agac_tpu/clockseam.py",
+    "agac_tpu/sim/",
+    "agac_tpu/cluster/rest.py",
+    "agac_tpu/cluster/testserver.py",
+    "agac_tpu/cloudprovider/aws/real_backend.py",
+    "agac_tpu/cloudprovider/aws/sigv4.py",
+)
+
+
+def _clock_rule_applies(ctx: LintContext) -> bool:
+    path = str(ctx.path).replace("\\", "/")
+    if "agac_tpu/" not in path:
+        return False  # tests and bench drive real threads on purpose
+    tail = "agac_tpu/" + path.split("agac_tpu/", 1)[1]
+    return not tail.startswith(_CLOCK_SANCTIONED)
+
+
+@rule(
+    "unseamed-clock",
+    "direct time.time()/time.monotonic()/time.sleep()/threading.Timer outside "
+    "the clock seam — wall-clock reads and sleeps must route through "
+    "agac_tpu/clockseam.py (or an injected clock) so the deterministic "
+    "simulation runtime can run the whole subsystem on virtual time",
+)
+def check_unseamed_clock(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
+    if not _clock_rule_applies(ctx):
+        return
+    # names bound by `from time import sleep [as pause]` / `from
+    # threading import Timer [as T]`
+    from_time: dict[str, str] = {}
+    timer_names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        if node.module == "time":
+            for alias in node.names:
+                if alias.name in _CLOCK_ATTRS:
+                    from_time[alias.asname or alias.name] = alias.name
+        elif node.module == "threading":
+            for alias in node.names:
+                if alias.name == "Timer":
+                    timer_names.add(alias.asname or alias.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        attr = None
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+        ):
+            base = func.value.id
+            if base in ("time", "_time") and func.attr in _CLOCK_ATTRS:
+                attr = func.attr
+            elif base == "threading" and func.attr == "Timer":
+                yield _timer_violation(ctx, node)
+                continue
+        elif isinstance(func, ast.Name):
+            if func.id in from_time:
+                attr = from_time[func.id]
+            elif func.id in timer_names:
+                yield _timer_violation(ctx, node)
+                continue
+        if attr is not None:
+            yield Violation(
+                "unseamed-clock",
+                str(ctx.path),
+                node.lineno,
+                f"direct time.{attr}() stalls virtual time under the sim "
+                f"runtime — read {_CLOCK_SEAM_SUGGESTION[attr]} or accept an "
+                "injected clock/sleep",
+            )
+
+
+def _timer_violation(ctx: LintContext, node: ast.Call) -> Violation:
+    return Violation(
+        "unseamed-clock",
+        str(ctx.path),
+        node.lineno,
+        "threading.Timer fires on the real clock and escapes the "
+        "deterministic scheduler — use a seam-driven tick (injected "
+        "sleep loop or the sim scheduler's timers) instead",
+    )
 
 
 # ---------------------------------------------------------------------------

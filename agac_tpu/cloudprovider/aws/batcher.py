@@ -37,10 +37,9 @@ holding a worker through the linger (``AWSDriver`` raises
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, Optional
 
-from ... import klog
+from ... import clockseam, klog
 from ...observability import instruments
 from .errors import AWSAPIError
 from .types import Change
@@ -111,12 +110,28 @@ class ChangeBatcher:
         self,
         max_changes: int = 100,
         linger: float = 0.1,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
+        wait_full: Optional[Callable[[threading.Event, float], bool]] = None,
         registry=None,
     ):
         self.max_changes = max(1, min(max_changes, MAX_CHANGES_PER_CALL))
         self.linger = max(linger, 0.0)
-        self._clock = clock
+        self._clock = clock or clockseam.monotonic
+        # the leader's linger wait, seam-injectable (ISSUE 7): real
+        # Event.wait in threaded mode; under the sim runtime the
+        # default becomes a virtual-time advance, so a linger window
+        # costs zero wall clock and the commit lands at a
+        # deterministic virtual instant
+        if wait_full is not None:
+            self._wait_full = wait_full
+        elif clockseam.threads_enabled():
+            self._wait_full = lambda event, timeout: event.wait(timeout)
+        else:
+            def _virtual_wait(event: threading.Event, timeout: float) -> bool:
+                clockseam.sleep(timeout)
+                return event.is_set()
+
+            self._wait_full = _virtual_wait
         self._lock = threading.Lock()
         self._forming: dict[str, _ZoneBatch] = {}
         # cumulative counters (stats() / bench export)
@@ -187,7 +202,7 @@ class ChangeBatcher:
         # leader: gather co-submitters, then flush
         full = False
         if self.linger > 0:
-            full = batch.full_event.wait(self.linger)
+            full = self._wait_full(batch.full_event, self.linger)
         with self._lock:
             batch.closed = True
             if self._forming.get(zone_id) is batch:

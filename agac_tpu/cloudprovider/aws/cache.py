@@ -73,6 +73,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ... import clockseam
+
 from .errors import ListenerNotFoundException
 from .types import (
     CHANGE_ACTION_DELETE,
@@ -103,9 +105,9 @@ class HostedZoneCache:
     so the retry re-reads.  Loads are single-flight: concurrent
     missers wait for one zone list instead of issuing their own."""
 
-    def __init__(self, ttl: float = 60.0, clock: Callable[[], float] = time.monotonic):
+    def __init__(self, ttl: float = 60.0, clock: Optional[Callable[[], float]] = None):
         self._ttl = ttl
-        self._clock = clock
+        self._clock = clock or clockseam.monotonic
         self._lock = threading.Lock()
         self._zones: Optional[list] = None
         self._by_name: Optional[dict] = None
@@ -183,12 +185,12 @@ class DiscoveryCache:
     def __init__(
         self,
         ttl: float = 5.0,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
         degraded: Optional[Callable[[], bool]] = None,
         tags_ttl: Optional[float] = None,
     ):
         self._ttl = ttl
-        self._clock = clock
+        self._clock = clock or clockseam.monotonic
         # incremental snapshot refresh (ISSUE 6): with tags_ttl set,
         # a reload may REUSE the tags of accelerators the previous
         # snapshot already knew (``reusable_tags``) instead of paying
@@ -207,7 +209,15 @@ class DiscoveryCache:
         # bounded staleness beats a guaranteed error during a brownout
         self._degraded = degraded
         self._lock = threading.Lock()
-        self._snapshot: Optional[Snapshot] = None
+        # the snapshot proper: arn -> (Accelerator, tags), plus an
+        # inverted tag index (key, value) -> set of arns so tag-scan
+        # queries (`match`) cost O(result), not O(fleet) — the 7-day
+        # sim soak surfaced the linear scan as an O(N^2) convergence
+        # wall at N=10k.  ``_list_cache`` memoizes the list view
+        # ``get``/``peek`` hand out; any write drops it.
+        self._entries: Optional[dict[str, tuple[Accelerator, list[Tag]]]] = None
+        self._by_tag: dict[tuple[str, str], set[str]] = {}
+        self._list_cache: Optional[Snapshot] = None
         self._expires = 0.0
         # set while a load is in flight; completion (success or not)
         # sets it.  Guarded by _lock.
@@ -245,7 +255,7 @@ class DiscoveryCache:
             now = self._clock()
             due = (
                 self._tags_ttl is None
-                or self._snapshot is None
+                or self._entries is None
                 or self._tags_loaded_at is None
                 or now >= self._tags_loaded_at + self._tags_ttl
             )
@@ -254,14 +264,35 @@ class DiscoveryCache:
                 self._tags_refreshing = True
                 return {}
             self.tag_incremental_loads += 1
-            return {
-                accelerator.accelerator_arn: tags
-                for accelerator, tags in self._snapshot
-            }
+            return {arn: tags for arn, (_, tags) in self._entries.items()}
 
-    def get(self, loader: Callable[[], Snapshot]) -> Snapshot:
-        """Return the cached snapshot, loading through ``loader`` when
-        absent or expired.
+    @staticmethod
+    def _build_index(
+        entries: dict[str, tuple[Accelerator, list[Tag]]],
+    ) -> dict[tuple[str, str], set[str]]:
+        by_tag: dict[tuple[str, str], set[str]] = {}
+        for arn, (_, tags) in entries.items():
+            for tag in tags:
+                by_tag.setdefault((tag.key, tag.value), set()).add(arn)
+        return by_tag
+
+    def _index_add(self, arn: str, tags: list[Tag]) -> None:
+        for tag in tags:
+            self._by_tag.setdefault((tag.key, tag.value), set()).add(arn)
+
+    def _index_discard(self, arn: str, tags: list[Tag]) -> None:
+        for tag in tags:
+            bucket = self._by_tag.get((tag.key, tag.value))
+            if bucket is not None:
+                bucket.discard(arn)
+                if not bucket:
+                    del self._by_tag[(tag.key, tag.value)]
+
+    def _ensure(self, loader: Callable[[], Snapshot]):
+        """Guarantee a fresh snapshot, loading through ``loader`` when
+        absent or expired; returns ``(entries, by_tag)`` — the stored
+        structures on the normal path, transient ones when a journaled
+        ``invalidate`` poisoned the store.
 
         The load runs OUTSIDE the lock (holding it across the O(N)
         scan would convoy all workers behind one loader) and is
@@ -271,16 +302,16 @@ class DiscoveryCache:
         stored, so a stale scan can never mask a newer local write."""
         while True:
             with self._lock:
-                if self._snapshot is not None and self._clock() < self._expires:
+                if self._entries is not None and self._clock() < self._expires:
                     self.hits += 1
-                    return self._snapshot
+                    return self._entries, self._by_tag
                 if (
-                    self._snapshot is not None
+                    self._entries is not None
                     and self._degraded is not None
                     and self._degraded()
                 ):
                     self.stale_serves += 1
-                    return self._snapshot
+                    return self._entries, self._by_tag
                 if self._load_event is None:
                     self._load_event = event = threading.Event()
                     self._journal = []
@@ -305,34 +336,81 @@ class DiscoveryCache:
             self._load_event = None
             self._journal = None
             discard = False
+            entries = {
+                accelerator.accelerator_arn: (accelerator, list(tags))
+                for accelerator, tags in snapshot
+            }
             for op, payload in journal:
                 if op == "invalidate":
                     discard = True
                 elif op == "upsert":
                     accelerator, tags = payload
-                    snapshot = [
-                        item
-                        for item in snapshot
-                        if item[0].accelerator_arn != accelerator.accelerator_arn
-                    ] + [(accelerator, tags)]
+                    entries[accelerator.accelerator_arn] = (accelerator, tags)
                 else:  # remove
-                    snapshot = [
-                        item for item in snapshot if item[0].accelerator_arn != payload
-                    ]
+                    entries.pop(payload, None)
             if discard:
-                self._snapshot = None
+                self._entries = None
+                self._by_tag = {}
+                self._list_cache = None
                 self._expires = 0.0
                 self._tags_refreshing = False
+                result = (entries, self._build_index(entries))
             else:
-                self._snapshot = snapshot
+                self._entries = entries
+                self._by_tag = self._build_index(entries)
+                self._list_cache = None
                 self._expires = self._clock() + self._ttl
                 if self._tags_refreshing:
                     # this load was a full tag refresh: restart the
                     # incremental-reuse window from its completion
                     self._tags_loaded_at = self._clock()
                     self._tags_refreshing = False
+                result = (entries, self._by_tag)
         event.set()
-        return snapshot
+        return result
+
+    def get(self, loader: Callable[[], Snapshot]) -> Snapshot:
+        """The full snapshot as a list of (accelerator, tags) pairs,
+        loading when absent or expired (see ``_ensure``).  The list
+        view is memoized until the next write, so repeated full walks
+        (GC sweeps, drift ticks) share one materialization."""
+        entries, _ = self._ensure(loader)
+        with self._lock:
+            if entries is self._entries:
+                if self._list_cache is None:
+                    self._list_cache = list(entries.values())
+                return self._list_cache
+        return list(entries.values())
+
+    def match(
+        self, loader: Callable[[], Snapshot], want: dict[str, str]
+    ) -> Snapshot:
+        """All (accelerator, tags) pairs whose tags contain every
+        (key, value) in ``want``, answered from the inverted tag index
+        in O(candidates of the rarest key) — for the owner-tag scans
+        every reconcile issues, O(1) instead of O(fleet).  Results are
+        ordered by arn so iteration order never depends on set/hash
+        order (the sim's replay contract)."""
+        entries, by_tag = self._ensure(loader)
+        with self._lock:
+            candidates: Optional[set[str]] = None
+            for pair in want.items():
+                bucket = by_tag.get(pair)
+                if not bucket:
+                    return []
+                if candidates is None or len(bucket) < len(candidates):
+                    candidates = bucket
+            if candidates is None:
+                return list(entries.values())
+            result = []
+            for arn in sorted(candidates):
+                entry = entries.get(arn)
+                if entry is not None and all(
+                    (key, value) in by_tag and arn in by_tag[(key, value)]
+                    for key, value in want.items()
+                ):
+                    result.append(entry)
+        return result
 
     def peek(self) -> Optional[Snapshot]:
         """The current snapshot WITHOUT loading, even when expired —
@@ -340,13 +418,19 @@ class DiscoveryCache:
         are upserted write-through so the peek is exact for them, and
         the scheduler thread must never dispatch an O(N) scan."""
         with self._lock:
-            return self._snapshot
+            if self._entries is None:
+                return None
+            if self._list_cache is None:
+                self._list_cache = list(self._entries.values())
+            return self._list_cache
 
     def invalidate(self) -> None:
         """External/unknown change: drop the snapshot, and poison any
         in-flight load so its result is returned but not stored."""
         with self._lock:
-            self._snapshot = None
+            self._entries = None
+            self._by_tag = {}
+            self._list_cache = None
             self._expires = 0.0
             if self._journal is not None:
                 self._journal.append(("invalidate", None))
@@ -365,12 +449,13 @@ class DiscoveryCache:
         with self._lock:
             if self._journal is not None:
                 self._journal.append(("upsert", entry))
-            if self._snapshot is not None:
-                self._snapshot = [
-                    item
-                    for item in self._snapshot
-                    if item[0].accelerator_arn != accelerator.accelerator_arn
-                ] + [entry]
+            if self._entries is not None:
+                old = self._entries.get(accelerator.accelerator_arn)
+                if old is not None:
+                    self._index_discard(accelerator.accelerator_arn, old[1])
+                self._entries[accelerator.accelerator_arn] = entry
+                self._index_add(accelerator.accelerator_arn, entry[1])
+                self._list_cache = None
 
     def remove(self, accelerator_arn: str) -> None:
         """Drop a locally deleted accelerator from the snapshot (same
@@ -378,12 +463,11 @@ class DiscoveryCache:
         with self._lock:
             if self._journal is not None:
                 self._journal.append(("remove", accelerator_arn))
-            if self._snapshot is not None:
-                self._snapshot = [
-                    item
-                    for item in self._snapshot
-                    if item[0].accelerator_arn != accelerator_arn
-                ]
+            if self._entries is not None:
+                old = self._entries.pop(accelerator_arn, None)
+                if old is not None:
+                    self._index_discard(accelerator_arn, old[1])
+                self._list_cache = None
 
 
 # ---------------------------------------------------------------------------
@@ -457,11 +541,11 @@ class AcceleratorTopologyCache:
         self,
         verify_ttl: float = 15.0,
         full_ttl: float = 900.0,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self._verify_ttl = verify_ttl
         self._full_ttl = full_ttl
-        self._clock = clock
+        self._clock = clock or clockseam.monotonic
         self._lock = threading.Lock()
         self._entries: dict[str, _TopologyEntry] = {}
         self.hits = 0       # served from the verified window
@@ -684,11 +768,11 @@ class RecordSetCache:
     def __init__(
         self,
         ttl: float = 15.0,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
         degraded: Optional[Callable[[], bool]] = None,
     ):
         self._ttl = ttl
-        self._clock = clock
+        self._clock = clock or clockseam.monotonic
         # health-plane hook (factory wires it to "is the Route53
         # circuit open"): serve expired zone snapshots stale while the
         # service is down instead of dispatching doomed reloads —
@@ -846,13 +930,13 @@ class LoadBalancerCoalescer:
         self,
         ttl: float = 15.0,
         batch_window: float = 0.01,
-        clock: Callable[[], float] = time.monotonic,
-        sleep: Callable[[float], None] = time.sleep,
+        clock: Optional[Callable[[], float]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
     ):
         self._ttl = ttl
         self._batch_window = batch_window
-        self._clock = clock
-        self._sleep = sleep
+        self._clock = clock or clockseam.monotonic
+        self._sleep = sleep or clockseam.sleep
         self._lock = threading.Lock()
         self._cache: dict[str, tuple[LoadBalancer, float]] = {}
         self._forming: Optional[_LBBatch] = None

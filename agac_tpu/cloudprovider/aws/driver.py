@@ -34,7 +34,7 @@ import json
 import time
 from typing import Callable, Optional
 
-from ... import apis, klog
+from ... import apis, clockseam, klog
 from ...observability import trace
 from ...observability.instruments import instrument_api
 from ...reconcile.pending import SETTLE_FAILED, SETTLE_READY, SettleWait
@@ -331,7 +331,7 @@ class AWSDriver:
         route53: Route53API,
         poll_interval: float = 10.0,
         poll_timeout: float = 180.0,
-        sleep: Callable[[float], None] = time.sleep,
+        sleep: Optional[Callable[[float], None]] = None,
         lb_not_active_retry: float = LB_NOT_ACTIVE_RETRY,
         accelerator_missing_retry: float = ACCELERATOR_MISSING_RETRY,
         discovery_cache=None,
@@ -354,7 +354,7 @@ class AWSDriver:
         self.route53 = instrument_api(route53, "route53", api_health.ROUTE53_OPS)
         self._poll_interval = poll_interval
         self._poll_timeout = poll_timeout
-        self._sleep = sleep
+        self._sleep = sleep or clockseam.sleep
         self._lb_not_active_retry = lb_not_active_retry
         self._accelerator_missing_retry = accelerator_missing_retry
         # optional shared DiscoveryCache (see cloudprovider/aws/cache.py):
@@ -487,9 +487,11 @@ class AWSDriver:
         ListTagsForResource per object — identical data, one less GA
         read, staleness bounded by the discovery TTL either way."""
         if self._discovery_cache is not None:
-            snapshot = self._discovery_cache.get(self._load_discovery_snapshot)
-        else:
-            snapshot = self._load_discovery_snapshot()
+            # indexed tag lookup: O(matches), not a full-fleet scan —
+            # the linear scan here was the O(N^2) convergence wall the
+            # 7-day sim soak surfaced at N=10k
+            return self._discovery_cache.match(self._load_discovery_snapshot, want)
+        snapshot = self._load_discovery_snapshot()
         result = []
         for accelerator, tags in snapshot:
             if tags_contains_all_values(tags, want):
@@ -1137,7 +1139,7 @@ class AWSDriver:
         ONLY as the fallback when no pending-settle table is wired —
         the lint rule ``blocking-settle-in-worker`` pins every other
         worker-reachable settle loop out of existence."""
-        deadline = time.monotonic() + self._poll_timeout
+        deadline = clockseam.monotonic() + self._poll_timeout
         with trace.span("settle-poll", arn=arn):
             while True:  # agac-lint: ignore[blocking-settle-in-worker] -- reference-parity fallback when no pending-settle table is wired; deadline-bounded
                 accelerator = self.ga.describe_accelerator(arn)
@@ -1146,7 +1148,7 @@ class AWSDriver:
                         "Global Accelerator %s is %s", arn, accelerator.status
                     )
                     return
-                if time.monotonic() >= deadline:
+                if clockseam.monotonic() >= deadline:
                     raise AWSAPIError(
                         "Timeout", f"accelerator {arn} did not settle within {self._poll_timeout}s"
                     )

@@ -45,6 +45,7 @@ from collections import deque
 from dataclasses import replace
 from typing import Callable, Optional
 
+from ... import clockseam
 from ...analysis import racecheck
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
 from .errors import (
@@ -385,7 +386,10 @@ class FaultPlan:
         remaining = deadline_remaining()
         wait = self.max_hang if remaining is None else min(remaining + 0.05, self.max_hang)
         if wait > 0:
-            threading.Event().wait(wait)
+            # through the clock seam (ISSUE 7): a hang fault burns
+            # VIRTUAL time under the sim runtime instead of stalling
+            # the cooperative scheduler on a real Event wait
+            clockseam.sleep(wait)
         raise AWSAPIError("RequestTimeout", f"fault plan: {op} hung past deadline")
 
     def _die(self, crash: SimulatedCrash) -> None:
@@ -471,6 +475,19 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         self._zones: dict[str, HostedZone] = guard("_zones")  # id -> zone
         self._records: dict[str, dict[tuple[str, str], ResourceRecordSet]] = guard("_records")
         self._counter = _SerialCounter()
+        # derived indexes (plain dicts, always mutated under the lock;
+        # insertion-ordered so iteration stays deterministic for the
+        # sim replay contract): arns still settling toward DEPLOYED —
+        # so a ListAccelerators page settles O(pending), not O(fleet) —
+        # and listener arn -> its endpoint-group arns, so per-chain
+        # listing is O(chain), not a scan of every group in the fleet
+        self._settling: dict[str, None] = {}
+        self._egs_by_listener: dict[str, dict[str, None]] = {}
+        # memoized ListAccelerators item list, dropped whenever any
+        # accelerator payload changes — a paginated drain at N=10k is
+        # ~100 page calls, and rebuilding the O(N) list per page made
+        # every drain O(N^2/page) in the 7-day sim soak
+        self._accel_list_cache: "Optional[list[Accelerator]]" = None
         # call log for assertions ("CreateAccelerator", arn), ...
         self.calls: list[tuple] = []
         # first-class fault injection (see FaultPlan); None = clean
@@ -578,6 +595,31 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
                 len(self._endpoint_groups),
             )
 
+    def accelerator_owners(self) -> dict[str, Optional[str]]:
+        """arn -> owner-tag value — a test/oracle helper read that is
+        neither faulted nor call-counted (sim oracles snapshot GC
+        ground truth through this without perturbing fault budgets or
+        quiescence windows)."""
+        with self._lock:
+            return {
+                arn: next(
+                    (
+                        t.value
+                        for t in state.tags
+                        # keep in sync with driver.OWNER_TAG_KEY (the
+                        # fake never imports the driver)
+                        if t.key == "aws-global-accelerator-owner"
+                    ),
+                    None,
+                )
+                for arn, state in self._accelerators.items()
+            }
+
+    def all_hosted_zone_ids(self) -> list[str]:
+        """Every hosted-zone id (unfaulted helper; see above)."""
+        with self._lock:
+            return sorted(self._zones.keys())
+
     # ------------------------------------------------------------------
     # GlobalAcceleratorAPI
     # ------------------------------------------------------------------
@@ -588,6 +630,8 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
                 state.accelerator = replace(
                     state.accelerator, status=ACCELERATOR_STATUS_DEPLOYED
                 )
+                self._settling.pop(state.accelerator.accelerator_arn, None)
+                self._accel_list_cache = None
 
     def _get_state(self, arn: str) -> _AcceleratorState:
         state = self._accelerators.get(arn)
@@ -598,10 +642,17 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
     def list_accelerators(self, max_results, next_token):
         with self._lock:
             self.calls.append(("ListAccelerators",))
-            for state in self._accelerators.values():
-                self._settle(state)
-            items = [s.accelerator for s in self._accelerators.values()]
-            return _paginate(items, max_results, next_token)
+            for arn in list(self._settling):
+                state = self._accelerators.get(arn)
+                if state is None:
+                    self._settling.pop(arn, None)
+                else:
+                    self._settle(state)
+            if self._accel_list_cache is None:
+                self._accel_list_cache = [
+                    s.accelerator for s in self._accelerators.values()
+                ]
+            return _paginate(self._accel_list_cache, max_results, next_token)
 
     def describe_accelerator(self, arn):
         with self._lock:
@@ -644,6 +695,9 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             self._accelerators[arn] = _AcceleratorState(
                 accelerator, list(tags), self.settle_describes
             )
+            self._accel_list_cache = None
+            if self.settle_describes:
+                self._settling[arn] = None
             self.calls.append(("CreateAccelerator", arn))
             return accelerator
 
@@ -660,7 +714,9 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             if self.settle_describes:
                 changes["status"] = ACCELERATOR_STATUS_IN_PROGRESS
                 state.pending_describes = self.settle_describes
+                self._settling[arn] = None
             state.accelerator = replace(state.accelerator, **changes)
+            self._accel_list_cache = None
             self.calls.append(("UpdateAccelerator", arn))
             return state.accelerator
 
@@ -676,6 +732,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
                     ERR_ASSOCIATED_LISTENER_FOUND, "accelerator still has listeners"
                 )
             del self._accelerators[arn]
+            self._accel_list_cache = None
             self.calls.append(("DeleteAccelerator", arn))
 
     def list_tags_for_resource(self, arn):
@@ -759,7 +816,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
     def delete_listener(self, arn):
         with self._lock:
             listener = self._get_listener(arn)
-            if any(parent == arn for parent in self._eg_parent.values()):
+            if self._egs_by_listener.get(arn):
                 raise AWSAPIError(
                     ERR_ASSOCIATED_ENDPOINT_GROUP_FOUND,
                     "listener still has endpoint groups",
@@ -773,9 +830,8 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             self.calls.append(("ListEndpointGroups", listener_arn))
             self._get_listener(listener_arn)  # existence check
             items = [
-                self._copy_eg(eg)
-                for arn, eg in self._endpoint_groups.items()
-                if self._eg_parent[arn] == listener_arn
+                self._copy_eg(self._endpoint_groups[arn])
+                for arn in self._egs_by_listener.get(listener_arn, ())
             ]
             return _paginate(items, max_results, next_token)
 
@@ -819,9 +875,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         self._validate_endpoint_configurations(endpoint_configurations)
         with self._lock:
             self._get_listener(listener_arn)
-            groups_on_listener = sum(
-                1 for parent in self._eg_parent.values() if parent == listener_arn
-            )
+            groups_on_listener = len(self._egs_by_listener.get(listener_arn, ()))
             if groups_on_listener >= self.quota_endpoint_groups_per_listener:
                 raise AWSAPIError(
                     ERR_LIMIT_EXCEEDED,
@@ -843,6 +897,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             )
             self._endpoint_groups[arn] = eg
             self._eg_parent[arn] = listener_arn
+            self._egs_by_listener.setdefault(listener_arn, {})[arn] = None
             self.calls.append(("CreateEndpointGroup", arn))
             return self._copy_eg(eg)
 
@@ -871,7 +926,12 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             if arn not in self._endpoint_groups:
                 raise EndpointGroupNotFoundException(arn)
             del self._endpoint_groups[arn]
-            del self._eg_parent[arn]
+            parent = self._eg_parent.pop(arn)
+            bucket = self._egs_by_listener.get(parent)
+            if bucket is not None:
+                bucket.pop(arn, None)
+                if not bucket:
+                    del self._egs_by_listener[parent]
             self.calls.append(("DeleteEndpointGroup", arn))
 
     def add_endpoints(self, arn, endpoint_configurations):
@@ -1138,6 +1198,14 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
         self._reload_if_changed()
         return super().chain_counts()
 
+    def accelerator_owners(self):
+        self._reload_if_changed()
+        return super().accelerator_owners()
+
+    def all_hosted_zone_ids(self):
+        self._reload_if_changed()
+        return super().all_hosted_zone_ids()
+
     def zone_id_by_name(self, name: str) -> Optional[str]:
         """Resolve a zone id by name — the assertion-side lookup a
         fresh process needs (zone IDS are minted by whichever process
@@ -1212,6 +1280,9 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
         self._counter.value = max(self._counter.value, int(data.get("counter", 1)))
         self._accelerators.clear()
         self._listener_parent.clear()
+        self._settling.clear()
+        self._egs_by_listener.clear()
+        self._accel_list_cache = None
         for entry in data.get("accelerators", []):
             accelerator = Accelerator(**entry["accelerator"])
             state = _AcceleratorState(
@@ -1231,6 +1302,8 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
                     accelerator.accelerator_arn
                 )
             self._accelerators[accelerator.accelerator_arn] = state
+            if state.pending_describes > 0:
+                self._settling[accelerator.accelerator_arn] = None
         self._endpoint_groups.clear()
         self._eg_parent.clear()
         for entry in data.get("endpoint_groups", []):
@@ -1243,6 +1316,9 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
             )
             self._endpoint_groups[eg.endpoint_group_arn] = eg
             self._eg_parent[eg.endpoint_group_arn] = entry["parent"]
+            self._egs_by_listener.setdefault(entry["parent"], {})[
+                eg.endpoint_group_arn
+            ] = None
         self._load_balancers.clear()
         for entry in data.get("load_balancers", []):
             lb = LoadBalancer(**entry)

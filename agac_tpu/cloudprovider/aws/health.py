@@ -41,10 +41,11 @@ from __future__ import annotations
 import inspect
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from ... import klog
+from ... import clockseam, klog
 from ...observability import instruments
 from ...observability.metrics import MetricsRegistry
 from .api import ELBv2API, GlobalAcceleratorAPI, Route53API
@@ -152,13 +153,14 @@ _deadline_state = threading.local()
 
 
 def set_reconcile_deadline(
-    timeout: float, clock: Callable[[], float] = time.monotonic
+    timeout: float, clock: Optional[Callable[[], float]] = None
 ) -> None:
     """Arm this worker's reconcile deadline ``timeout`` seconds from
     now; 0/negative clears it."""
     if timeout <= 0:
         clear_reconcile_deadline()
         return
+    clock = clock or clockseam.monotonic
     _deadline_state.deadline = clock() + timeout
     _deadline_state.clock = clock
 
@@ -177,7 +179,7 @@ def deadline_remaining() -> Optional[float]:
     deadline = reconcile_deadline()
     if deadline is None:
         return None
-    clock = getattr(_deadline_state, "clock", None) or time.monotonic
+    clock = getattr(_deadline_state, "clock", None) or clockseam.monotonic
     return deadline - clock()
 
 
@@ -218,17 +220,20 @@ class CircuitBreaker:
         failure_ratio: float = 0.5,
         open_duration: float = 15.0,
         probe_budget: int = 1,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self._window = window
         self._min_calls = max(1, min_calls)
         self._failure_ratio = failure_ratio
         self._open_duration = open_duration
         self._probe_budget = max(1, probe_budget)
-        self._clock = clock
+        self._clock = clock or clockseam.monotonic
         self._lock = threading.Lock()
         self._state = STATE_CLOSED
-        self._outcomes: list[tuple[float, bool]] = []  # (time, failed)
+        # (time, failed), append-only in clock order; pruning pops
+        # from the left so a busy window costs O(evictions), not a
+        # full rebuild per call (a 7-day sim soak hot spot)
+        self._outcomes: deque[tuple[float, bool]] = deque()
         self._opened_at = 0.0
         self._probes_left = 0
         self._probe_interval_start = 0.0
@@ -237,7 +242,8 @@ class CircuitBreaker:
 
     def _prune(self, now: float) -> None:
         cutoff = now - self._window
-        self._outcomes = [o for o in self._outcomes if o[0] > cutoff]
+        while self._outcomes and self._outcomes[0][0] <= cutoff:
+            self._outcomes.popleft()
 
     def state(self) -> str:
         with self._lock:
@@ -282,7 +288,7 @@ class CircuitBreaker:
                 else:
                     # probe succeeded: close with a clean window
                     self._state = STATE_CLOSED
-                    self._outcomes = []
+                    self._outcomes.clear()
                 return
             if state == STATE_OPEN:
                 # stragglers that were in flight when the circuit
@@ -299,7 +305,7 @@ class CircuitBreaker:
     def _trip(self, now: float) -> None:
         self._state = STATE_OPEN
         self._opened_at = now
-        self._outcomes = []
+        self._outcomes.clear()
         self.opened_total += 1
 
     def snapshot(self) -> dict:
@@ -340,7 +346,7 @@ class AIMDLimiter:
         increase: float = 0.2,
         decrease: float = 0.5,
         burst: Optional[int] = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
     ):
         # the existing token bucket (reconcile.workqueue) is the
         # enforcement layer; imported lazily to keep this package free
@@ -408,13 +414,13 @@ class ServiceHealth:
         self,
         name: str,
         config: HealthConfig,
-        clock: Callable[[], float] = time.monotonic,
-        sleep: Callable[[float], None] = time.sleep,
+        clock: Optional[Callable[[], float]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
         registry: Optional[MetricsRegistry] = None,
     ):
         self.name = name
         self._config = config
-        self._sleep = sleep
+        self._sleep = sleep or clockseam.sleep
         self.breaker = CircuitBreaker(
             window=config.window,
             min_calls=config.min_calls,
@@ -559,13 +565,13 @@ class HealthTracker:
     def __init__(
         self,
         config: Optional[HealthConfig] = None,
-        clock: Callable[[], float] = time.monotonic,
-        sleep: Callable[[float], None] = time.sleep,
+        clock: Optional[Callable[[], float]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
         registry: Optional[MetricsRegistry] = None,
     ):
         self.config = config or HealthConfig()
-        self._clock = clock
-        self._sleep = sleep
+        self._clock = clock or clockseam.monotonic
+        self._sleep = sleep or clockseam.sleep
         # one registry for every service's counters/gauges; private by
         # default (tests build many trackers per process), the factory
         # passes the process-global registry so /metrics carries them
@@ -620,8 +626,8 @@ class WorkerHeartbeats:
     liveness table behind the stuck-worker watchdog, the manager's
     ``/healthz``, and shutdown's who-wedged-on-what logging."""
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
-        self._clock = clock
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self._clock = clock or clockseam.monotonic
         self._lock = threading.Lock()
         self._table: dict[str, tuple[str, float]] = {}  # thread -> (key, since)
 

@@ -25,11 +25,10 @@ from __future__ import annotations
 
 import queue as queue_mod
 import threading
-import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
-from .. import klog
+from .. import clockseam, klog
 from ..analysis import racecheck
 from ..errors import NotFoundError
 from ..observability import instruments
@@ -55,10 +54,19 @@ class _Handler:
 
 
 class SharedInformer:
-    def __init__(self, client: ClusterClient, kind: str, resync_period: float = 30.0):
+    def __init__(
+        self,
+        client: ClusterClient,
+        kind: str,
+        resync_period: float = 30.0,
+        clock: Optional[Callable[[], float]] = None,
+    ):
         self._client = client
         self.kind = kind
         self._resync_period = resync_period
+        # the clock seam (ISSUE 7): resync deadlines and the
+        # resync-age gauge run on virtual time under the sim runtime
+        self._clock = clock or clockseam.monotonic
         # racecheck seam: instrumented when the lock-order watchdog is
         # enabled — the store lock is acquired from the watch, dispatch
         # and every controller thread (via lister reads)
@@ -80,7 +88,7 @@ class SharedInformer:
             lambda: (
                 -1.0
                 if self._last_relist < 0
-                else max(0.0, time.monotonic() - self._last_relist)
+                else max(0.0, self._clock() - self._last_relist)
             )
         )
         informer_metrics.items.labels(kind=kind).set_function(
@@ -146,8 +154,8 @@ class SharedInformer:
             try:
                 rv = self._relist()
                 self._synced.set()
-                deadline = time.monotonic() + self._resync_period
-                should_stop = lambda: stop.is_set() or time.monotonic() >= deadline
+                deadline = self._clock() + self._resync_period
+                should_stop = lambda: stop.is_set() or self._clock() >= deadline
                 for event in self._client.watch(self.kind, rv, should_stop):
                     self._apply(event.type, event.obj)
             except Exception as err:
@@ -172,7 +180,7 @@ class SharedInformer:
             for key, obj in old.items():
                 if key not in fresh:
                     self._deltas.put(("delete", None, Tombstone(key, obj), handlers))
-        self._last_relist = time.monotonic()
+        self._last_relist = self._clock()
         return rv
 
     def _apply(self, event_type: str, obj: Any) -> None:
@@ -194,19 +202,55 @@ class SharedInformer:
     def _dispatch_loop(self, stop: threading.Event) -> None:
         while not stop.is_set():
             try:
-                action, old, obj, handlers = self._deltas.get(timeout=0.05)
+                delta = self._deltas.get(timeout=0.05)
             except queue_mod.Empty:
                 continue
-            for h in handlers:
-                try:
-                    if action == "add" and h.on_add:
-                        h.on_add(obj)
-                    elif action == "update" and h.on_update:
-                        h.on_update(old, obj)
-                    elif action == "delete" and h.on_delete:
-                        h.on_delete(obj)
-                except Exception as err:  # handler crash containment
-                    klog.errorf("informer %s: handler error: %s", self.kind, err)
+            self._dispatch_one(delta)
+
+    def _dispatch_one(self, delta) -> None:
+        action, old, obj, handlers = delta
+        for h in handlers:
+            try:
+                if action == "add" and h.on_add:
+                    h.on_add(obj)
+                elif action == "update" and h.on_update:
+                    h.on_update(old, obj)
+                elif action == "delete" and h.on_delete:
+                    h.on_delete(obj)
+            except Exception as err:  # handler crash containment
+                klog.errorf("informer %s: handler error: %s", self.kind, err)
+
+    # ---- cooperative stepping (the sim runtime's seam, ISSUE 7) --------
+    # The threaded run() above is wall-clock plumbing around exactly
+    # these three steps; the sim scheduler calls them explicitly so
+    # relist timing, event application and handler dispatch all happen
+    # at deterministic points in virtual time.
+
+    def sync_once(self) -> str:
+        """One relist + synchronous handler dispatch; marks the
+        informer synced and returns the list's resourceVersion (the
+        watch cursor the sim pump resumes from)."""
+        rv = self._relist()
+        self._synced.set()
+        self.drain_pending_deltas()
+        return rv
+
+    def apply_event(self, event) -> None:
+        """Apply one watch event to the store and enqueue its handler
+        delta (drained by ``drain_pending_deltas``)."""
+        self._apply(event.type, event.obj)
+
+    def drain_pending_deltas(self) -> int:
+        """Dispatch every queued delta inline on the calling thread;
+        returns how many were delivered."""
+        delivered = 0
+        while True:
+            try:
+                delta = self._deltas.get_nowait()
+            except queue_mod.Empty:
+                return delivered
+            self._dispatch_one(delta)
+            delivered += 1
 
 
 class Lister:
@@ -233,9 +277,20 @@ class SharedInformerFactory:
     (the analog of ``informers.NewSharedInformerFactory`` +
     ``factory.Start``, reference ``pkg/manager/manager.go:52-72``)."""
 
-    def __init__(self, client: ClusterClient, resync_period: float = 30.0):
+    def __init__(
+        self,
+        client: ClusterClient,
+        resync_period: float = 30.0,
+        clock: Optional[Callable[[], float]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
+    ):
         self._client = client
         self._resync_period = resync_period
+        # clock/sleep seam (ISSUE 7): threaded through to every
+        # informer and used by wait_for_cache_sync's poll below — the
+        # last hard-coded time.sleep that would stall virtual time
+        self._clock = clock or clockseam.monotonic
+        self._sleep = sleep or clockseam.sleep
         self._informers: dict[str, SharedInformer] = {}
         self._lock = racecheck.make_lock("informer-factory")
 
@@ -243,9 +298,15 @@ class SharedInformerFactory:
         with self._lock:
             if kind not in self._informers:
                 self._informers[kind] = SharedInformer(
-                    self._client, kind, self._resync_period
+                    self._client, kind, self._resync_period, clock=self._clock
                 )
             return self._informers[kind]
+
+    def informers(self) -> list[SharedInformer]:
+        """Every informer built so far — the sim harness's pump walks
+        them in deterministic (construction) order."""
+        with self._lock:
+            return list(self._informers.values())
 
     def start(self, stop: threading.Event) -> None:
         with self._lock:
@@ -256,12 +317,12 @@ class SharedInformerFactory:
     def wait_for_cache_sync(self, stop: threading.Event, timeout: float = 30.0) -> bool:
         """Block until every started informer has synced
         (``cache.WaitForCacheSync`` analog)."""
-        deadline = time.monotonic() + timeout
+        deadline = self._clock() + timeout
         with self._lock:
             informers = list(self._informers.values())
         for inf in informers:
             while not inf.has_synced():
-                if stop.is_set() or time.monotonic() > deadline:
+                if stop.is_set() or self._clock() > deadline:
                     return False
-                time.sleep(0.005)
+                self._sleep(0.005)
         return True

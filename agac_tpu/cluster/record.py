@@ -33,7 +33,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Callable
 
-from .. import klog
+from .. import clockseam, klog
 from .client import ClusterClient
 from .objects import Event, EventSource, ObjectMeta, ObjectReference
 
@@ -63,11 +63,26 @@ class EventRecorder:
         self,
         client: ClusterClient,
         component: str,
-        clock: Callable[[], float] = time.time,
+        clock: Callable[[], float] | None = None,
+        monotonic: Callable[[], float] | None = None,
+        sleep: Callable[[float], None] | None = None,
+        synchronous: bool | None = None,
     ):
         self._client = client
         self._component = component
-        self._clock = clock
+        # clock seam (ISSUE 7): wall clock stamps the events, the
+        # monotonic/sleep pair bounds flush() — all virtual under sim
+        self._clock = clock or clockseam.time
+        self._monotonic = monotonic or clockseam.monotonic
+        self._sleep = sleep or clockseam.sleep
+        # threadless mode (sim runtime): persist inline on the emitting
+        # thread instead of a worker thread, so apiserver writes land
+        # at deterministic points in the cooperative schedule
+        self._synchronous = (
+            synchronous
+            if synchronous is not None
+            else not clockseam.threads_enabled()
+        )
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         # true LRU: touched entries move to the end, eviction pops the
@@ -79,6 +94,7 @@ class EventRecorder:
         self._worker: threading.Thread | None = None
         self._stopped = False
         self._inflight = 0
+        self._last_name_suffix = 0
 
     # ------------------------------------------------------------------
     # correlation (fast, lock-held, no I/O)
@@ -92,6 +108,13 @@ class EventRecorder:
         while len(self._buckets) > MAX_CACHE_ENTRIES:
             self._buckets.popitem(last=False)
         return filtered
+
+    def _next_name_suffix(self, now: float) -> int:
+        """Nanosecond-scale name suffix derived from the seamed wall
+        clock, bumped past the previous one so two events in the same
+        (virtual) instant still get distinct names."""
+        self._last_name_suffix = max(int(now * 1e9), self._last_name_suffix + 1)
+        return self._last_name_suffix
 
     def event(self, obj: Any, event_type: str, reason: str, message: str) -> None:
         meta = obj.metadata
@@ -114,8 +137,11 @@ class EventRecorder:
                 ev = Event(
                     metadata=ObjectMeta(
                         # unique across recorder instances and process
-                        # restarts, like client-go's UnixNano suffix
-                        name=f"{meta.name}.{time.time_ns():x}",
+                        # restarts, like client-go's UnixNano suffix —
+                        # read through the clock seam (plus a strictly
+                        # increasing floor for same-instant events) so
+                        # sim replays mint identical names
+                        name=f"{meta.name}.{self._next_name_suffix(now):x}",
                         namespace=meta.namespace or "default",
                     ),
                     involved_object=ObjectReference(
@@ -145,8 +171,12 @@ class EventRecorder:
                     return
                 series.dirty = True
                 self._queue.append(series_key)
-            self._ensure_worker()
-            self._wake.notify()
+            if not self._synchronous:
+                self._ensure_worker()
+                self._wake.notify()
+        if self._synchronous:
+            while self._drain_step():
+                pass
         klog.infof(
             'Event(%s/%s %s): type=%r reason=%r %s',
             meta.namespace, meta.name, kind, event_type, reason, message,
@@ -173,51 +203,65 @@ class EventRecorder:
                     self._wake.wait()
                 if not self._queue and self._stopped:
                     return
-                series_key = self._queue.popleft()
-                series = self._series.get(series_key)
-                if series is None:
-                    continue
-                series.dirty = False
-                self._inflight += 1
-                # snapshot what we persist — a COPY taken under the
-                # lock, because event() keeps mutating count and
-                # last_timestamp on the live object; serializing the
-                # live reference outside the lock could persist a torn
-                # view (new count, stale lastTimestamp).  Later bumps
-                # re-queue via the dirty flag.
-                snapshot = copy.deepcopy(series.event)
-                created = series.created
-            try:
-                if created:
-                    stored = self._client.update("Event", snapshot)
-                else:
-                    stored = self._client.create("Event", snapshot)
-            except Exception as err:
-                klog.errorf("failed to record event %s: %s", snapshot.reason, err)
-                with self._lock:
-                    self._inflight -= 1
-                    # stale/lost: the next occurrence starts fresh
-                    if self._series.get(series_key) is series:
-                        del self._series[series_key]
-                continue
+            self._drain_step()
+
+    def _drain_step(self) -> bool:
+        """Persist at most one queued series; False when the queue is
+        empty.  Shared by the worker thread and synchronous mode."""
+        with self._lock:
+            if not self._queue:
+                return False
+            series_key = self._queue.popleft()
+            series = self._series.get(series_key)
+            if series is None:
+                return True
+            series.dirty = False
+            self._inflight += 1
+            # snapshot what we persist — a COPY taken under the
+            # lock, because event() keeps mutating count and
+            # last_timestamp on the live object; serializing the
+            # live reference outside the lock could persist a torn
+            # view (new count, stale lastTimestamp).  Later bumps
+            # re-queue via the dirty flag.
+            snapshot = copy.deepcopy(series.event)
+            created = series.created
+        try:
+            if created:
+                stored = self._client.update("Event", snapshot)
+            else:
+                stored = self._client.create("Event", snapshot)
+        except Exception as err:
+            klog.errorf("failed to record event %s: %s", snapshot.reason, err)
             with self._lock:
                 self._inflight -= 1
+                # stale/lost: the next occurrence starts fresh
                 if self._series.get(series_key) is series:
-                    series.created = True
-                    series.event.metadata.resource_version = (
-                        stored.metadata.resource_version
-                    )
+                    del self._series[series_key]
+            return True
+        with self._lock:
+            self._inflight -= 1
+            if self._series.get(series_key) is series:
+                series.created = True
+                series.event.metadata.resource_version = (
+                    stored.metadata.resource_version
+                )
+        return True
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Block until every queued event has been persisted (tests
         and shutdown use this; reconcile paths never need to)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = self._monotonic() + timeout
+        while self._monotonic() < deadline:
             with self._lock:
                 if not self._queue and self._inflight == 0:
                     return True
-            time.sleep(0.002)
-        return False
+            if self._synchronous:
+                while self._drain_step():
+                    pass
+                continue
+            self._sleep(0.002)
+        with self._lock:
+            return not self._queue and self._inflight == 0
 
     def shutdown(self, timeout: float = 2.0) -> None:
         """Drain pending events and stop the worker (controllers call

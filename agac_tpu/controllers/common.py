@@ -15,7 +15,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
-from .. import apis, klog
+from .. import apis, clockseam, klog
 from ..cloudprovider.aws import AWSDriver, get_lb_name_from_hostname
 from ..cloudprovider.aws.health import CircuitOpenError
 from ..cluster.informer import Tombstone
@@ -118,18 +118,22 @@ def with_circuit_backoff(process):
 def run_workers(
     name: str,
     queue: RateLimitingQueue,
-    threadiness: int,
-    stop: threading.Event,
-    key_to_obj,
-    process_delete,
-    process_create_or_update,
+    workers: int = 1,
+    stop: threading.Event = None,
+    key_to_obj=None,
+    process_delete=None,
+    process_create_or_update=None,
     on_sync_result=None,
     reconcile_deadline: float | None = None,
 ) -> list[threading.Thread]:
-    """Launch ``threadiness`` worker threads looping
+    """Launch ``workers`` worker threads looping
     ``process_next_work_item`` until queue shutdown (the analog of
     ``wait.Until(runWorker, time.Second, stopCh)``,
     reference ``globalaccelerator/controller.go:206-211``).
+
+    The keyword shape matches the controllers' ``worker_specs()``
+    entries exactly: ``run_workers(workers=n, stop=stop, **spec)`` —
+    the same spec a sim harness steps cooperatively.
 
     Both process funcs are wrapped circuit-aware (see
     ``with_circuit_backoff``), and ``reconcile_deadline`` arms the
@@ -147,7 +151,7 @@ def run_workers(
                 break
 
     threads = []
-    for i in range(threadiness):
+    for i in range(workers):
         t = threading.Thread(target=loop, daemon=True, name=f"{name}-worker-{i}")
         t.start()
         threads.append(t)
@@ -271,7 +275,7 @@ def make_sync_error_warner(recorder, key_to_obj, threshold=SYNC_WARNING_RETRY_TH
             if err is None:
                 return
         else:
-            now = time.monotonic()
+            now = clockseam.monotonic()
             with lock:
                 count, last = failures.get(key, (0, -SYNC_WARNING_FAILURE_WINDOW))
                 count = count + 1 if now - last < SYNC_WARNING_FAILURE_WINDOW else 1

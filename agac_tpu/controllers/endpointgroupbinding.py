@@ -127,6 +127,24 @@ class EndpointGroupBindingController:
             )
         ]
 
+    def worker_specs(self) -> list[dict]:
+        """The canonical worker wiring (see the GlobalAccelerator
+        controller's docstring) — shared by run() and the sim
+        harness."""
+        return [
+            dict(
+                name=CONTROLLER_AGENT_NAME,
+                queue=self.workqueue,
+                key_to_obj=self._key_to_binding,
+                process_delete=self._process_deleted_key,
+                process_create_or_update=self.reconcile,
+                on_sync_result=make_sync_error_warner(
+                    self.recorder, self._key_to_binding
+                ),
+                reconcile_deadline=self._reconcile_deadline,
+            ),
+        ]
+
     # ------------------------------------------------------------------
     # run loop (reference ``controller.go:103-141``)
     # ------------------------------------------------------------------
@@ -136,17 +154,8 @@ class EndpointGroupBindingController:
         if not self._informer_factory.wait_for_cache_sync(stop):
             raise RuntimeError("failed to wait for caches to sync")
         klog.info("Starting workers")
-        run_workers(
-            CONTROLLER_AGENT_NAME,
-            self.workqueue,
-            self._workers,
-            stop,
-            self._key_to_binding,
-            self._process_deleted_key,
-            self.reconcile,
-            on_sync_result=make_sync_error_warner(self.recorder, self._key_to_binding),
-            reconcile_deadline=self._reconcile_deadline,
-        )
+        for spec in self.worker_specs():
+            run_workers(workers=self._workers, stop=stop, **spec)
         klog.info("Started workers")
         # plain dedup add, not add_rate_limited — see the
         # GlobalAccelerator controller's resync comment

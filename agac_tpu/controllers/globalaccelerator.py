@@ -214,28 +214,8 @@ class GlobalAcceleratorController:
         if not self._informer_factory.wait_for_cache_sync(stop):
             raise RuntimeError("failed to wait for caches to sync")
         klog.info("Starting workers")
-        run_workers(
-            f"{CONTROLLER_AGENT_NAME}-service",
-            self.service_queue,
-            self._workers,
-            stop,
-            self._key_to_service,
-            self.process_service_delete,
-            self.process_service_create_or_update,
-            on_sync_result=make_sync_error_warner(self.recorder, self._key_to_service),
-            reconcile_deadline=self._reconcile_deadline,
-        )
-        run_workers(
-            f"{CONTROLLER_AGENT_NAME}-ingress",
-            self.ingress_queue,
-            self._workers,
-            stop,
-            self._key_to_ingress,
-            self.process_ingress_delete,
-            self.process_ingress_create_or_update,
-            on_sync_result=make_sync_error_warner(self.recorder, self._key_to_ingress),
-            reconcile_deadline=self._reconcile_deadline,
-        )
+        for spec in self.worker_specs():
+            run_workers(workers=self._workers, stop=stop, **spec)
         klog.info("Started workers")
         # resync ticks use the plain dedup add, NOT add_rate_limited:
         # the client-go resync pattern.  Metered adds would drain the
@@ -251,6 +231,37 @@ class GlobalAcceleratorController:
         self.service_queue.shutdown()
         self.ingress_queue.shutdown()
         self.recorder.shutdown()
+
+    def worker_specs(self) -> list[dict]:
+        """The canonical worker wiring — (queue, key resolver, process
+        funcs, sync-result hook, deadline) per queue.  Consumed by the
+        threaded ``run`` loop above AND stepped cooperatively by the
+        sim harness (ISSUE 7), so the two runtimes reconcile through
+        identical plumbing."""
+        return [
+            dict(
+                name=f"{CONTROLLER_AGENT_NAME}-service",
+                queue=self.service_queue,
+                key_to_obj=self._key_to_service,
+                process_delete=self.process_service_delete,
+                process_create_or_update=self.process_service_create_or_update,
+                on_sync_result=make_sync_error_warner(
+                    self.recorder, self._key_to_service
+                ),
+                reconcile_deadline=self._reconcile_deadline,
+            ),
+            dict(
+                name=f"{CONTROLLER_AGENT_NAME}-ingress",
+                queue=self.ingress_queue,
+                key_to_obj=self._key_to_ingress,
+                process_delete=self.process_ingress_delete,
+                process_create_or_update=self.process_ingress_create_or_update,
+                on_sync_result=make_sync_error_warner(
+                    self.recorder, self._key_to_ingress
+                ),
+                reconcile_deadline=self._reconcile_deadline,
+            ),
+        ]
 
     def drift_resync_sources(self) -> list:
         """The canonical ``[(lister, predicate, enqueue), ...]`` drift

@@ -187,6 +187,35 @@ class Route53Controller:
             ),
         ]
 
+    def worker_specs(self) -> list[dict]:
+        """The canonical worker wiring (see the GlobalAccelerator
+        controller's docstring) — shared by run() and the sim
+        harness."""
+        return [
+            dict(
+                name=f"{CONTROLLER_AGENT_NAME}-service",
+                queue=self.service_queue,
+                key_to_obj=self._key_to_service,
+                process_delete=self.process_service_delete,
+                process_create_or_update=self.process_service_create_or_update,
+                on_sync_result=make_sync_error_warner(
+                    self.recorder, self._key_to_service
+                ),
+                reconcile_deadline=self._reconcile_deadline,
+            ),
+            dict(
+                name=f"{CONTROLLER_AGENT_NAME}-ingress",
+                queue=self.ingress_queue,
+                key_to_obj=self._key_to_ingress,
+                process_delete=self.process_ingress_delete,
+                process_create_or_update=self.process_ingress_create_or_update,
+                on_sync_result=make_sync_error_warner(
+                    self.recorder, self._key_to_ingress
+                ),
+                reconcile_deadline=self._reconcile_deadline,
+            ),
+        ]
+
     # ------------------------------------------------------------------
     # run loop
     # ------------------------------------------------------------------
@@ -196,28 +225,8 @@ class Route53Controller:
         if not self._informer_factory.wait_for_cache_sync(stop):
             raise RuntimeError("failed to wait for caches to sync")
         klog.info("Starting workers")
-        run_workers(
-            f"{CONTROLLER_AGENT_NAME}-service",
-            self.service_queue,
-            self._workers,
-            stop,
-            self._key_to_service,
-            self.process_service_delete,
-            self.process_service_create_or_update,
-            on_sync_result=make_sync_error_warner(self.recorder, self._key_to_service),
-            reconcile_deadline=self._reconcile_deadline,
-        )
-        run_workers(
-            f"{CONTROLLER_AGENT_NAME}-ingress",
-            self.ingress_queue,
-            self._workers,
-            stop,
-            self._key_to_ingress,
-            self.process_ingress_delete,
-            self.process_ingress_create_or_update,
-            on_sync_result=make_sync_error_warner(self.recorder, self._key_to_ingress),
-            reconcile_deadline=self._reconcile_deadline,
-        )
+        for spec in self.worker_specs():
+            run_workers(workers=self._workers, stop=stop, **spec)
         klog.info("Started workers")
         # plain dedup add, not add_rate_limited — see the
         # GlobalAccelerator controller's resync comment

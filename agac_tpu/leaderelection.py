@@ -30,12 +30,11 @@ This mirrors the ``observedRecord``/``observedTime`` pair in client-go's
 from __future__ import annotations
 
 import threading
-import time
 import uuid
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from . import klog
+from . import clockseam, klog
 from .analysis import racecheck
 from .cluster import ClusterClient, Lease
 from .cluster.objects import LeaseSpec, ObjectMeta
@@ -46,9 +45,12 @@ from .observability import instruments
 def _now_rfc3339() -> str:
     import datetime
 
-    return datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%S.%fZ"
-    )
+    # through the wall-clock seam so lease timestamps are virtual
+    # (and deterministic) under the sim runtime; freshness decisions
+    # never read these — they use the local monotonic clock below
+    return datetime.datetime.fromtimestamp(
+        clockseam.time(), datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
 
 
 @dataclass
@@ -65,11 +67,16 @@ class LeaderElection:
         namespace: str,
         config: Optional[LeaderElectionConfig] = None,
         identity: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self.name = name
         self.namespace = namespace
         self.config = config or LeaderElectionConfig()
         self.identity = identity or str(uuid.uuid4())
+        # the local monotonic clock all freshness decisions run on —
+        # virtual under the sim runtime (ISSUE 7), where lease churn
+        # plays out in virtual seconds
+        self._clock = clock or clockseam.monotonic
         self._leading = threading.Event()
         # Observed-record tracking (client-go's observedRecord /
         # observedTime): the lease's last-seen content and the local
@@ -90,6 +97,15 @@ class LeaderElection:
 
     def is_leader(self) -> bool:
         return self._leading.is_set()
+
+    def set_leading(self, leading: bool) -> None:
+        """Flip the leading flag from a cooperative driver (sim
+        elector actors own the acquire/renew state machine themselves;
+        the threaded ``run`` path manages this flag internally)."""
+        if leading:
+            self._leading.set()
+        else:
+            self._leading.clear()
 
     # ------------------------------------------------------------------
     def run(
@@ -125,12 +141,12 @@ class LeaderElection:
         renew_failed = threading.Event()
 
         def renew_loop():
-            deadline = time.monotonic() + self.config.renew_deadline
+            deadline = self._clock() + self.config.renew_deadline
             while not stop.is_set():
                 acquired, _ = self._try_acquire_or_renew(client)
                 if acquired:
-                    deadline = time.monotonic() + self.config.renew_deadline
-                elif time.monotonic() >= deadline:
+                    deadline = self._clock() + self.config.renew_deadline
+                elif self._clock() >= deadline:
                     klog.infof("leader lost: %s", self.identity)
                     self._leading.clear()
                     renew_failed.set()
@@ -158,6 +174,12 @@ class LeaderElection:
             self._leading.clear()
 
     # ------------------------------------------------------------------
+    def try_acquire_or_renew(self, client: ClusterClient) -> tuple[bool, str]:
+        """One acquire-or-renew attempt, public for cooperative
+        drivers (the sim runtime's elector actors step this explicitly
+        instead of running the threaded loops above)."""
+        return self._try_acquire_or_renew(client)
+
     def _try_acquire_or_renew(self, client: ClusterClient) -> tuple[bool, str]:
         """Returns (we_are_leader, current_holder)."""
         now = _now_rfc3339()
@@ -192,7 +214,7 @@ class LeaderElection:
         with self._observed_lock:
             if record != self._observed_record:
                 self._observed_record = record
-                self._observed_time = time.monotonic()
+                self._observed_time = self._clock()
             observed_time = self._observed_time
 
         holder = lease.spec.holder_identity or ""
@@ -207,7 +229,7 @@ class LeaderElection:
                 duration = (
                     lease.spec.lease_duration_seconds or self.config.lease_duration
                 )
-                if observed_time + duration > time.monotonic():
+                if observed_time + duration > self._clock():
                     return False, holder  # lease is held and fresh
             lease.spec.lease_transitions += 1
             lease.spec.acquire_time = now

@@ -126,6 +126,8 @@ class Manager:
             else obs_metrics.MetricsRegistry()
         )
         self.controllers: dict[str, object] = {}
+        # the shared informer factory build() wired (None until then)
+        self.informer_factory: Optional[SharedInformerFactory] = None
         # what the last drift_tick did, for bench_detail.json and tests:
         # {"enqueued": {controller: n}, "skipped": {controller: [svc]},
         #  "partial": bool}
@@ -139,6 +141,39 @@ class Manager:
         # pattern).
         self.settle_table = None
 
+    def build(
+        self,
+        client: ClusterClient,
+        config: ControllerConfig,
+        cloud_factory: Optional[CloudFactory] = None,
+        informer_factory: Optional[SharedInformerFactory] = None,
+    ) -> SharedInformerFactory:
+        """Construct every registered controller (and the GC sweeper
+        when enabled) WITHOUT starting any thread.  ``run`` wraps this
+        with the threaded lifecycle; the deterministic sim harness
+        (``agac_tpu/sim/``) calls it directly and steps the same
+        controller objects cooperatively on virtual time — the two
+        runtimes can never drift apart on what a manager contains."""
+        informer_factory = informer_factory or SharedInformerFactory(
+            client, self._resync_period
+        )
+        self.informer_factory = informer_factory
+        for name, init in new_controller_initializers().items():
+            self.controllers[name] = init(
+                client, informer_factory, config, cloud_factory
+            )
+        gc_config = config.garbage_collector
+        if gc_config.interval > 0 and cloud_factory is not None:
+            # the sweeper shares the controllers' informer caches (its
+            # owner cross-checks must see the same world the reconciles
+            # do) and the same cloud factory (deletes flow through the
+            # shaped drivers); it never sweeps before those caches sync
+            self.gc = GarbageCollector(
+                informer_factory, gc_config, cloud_factory, health=self._health,
+                registry=self.metrics_registry,
+            )
+        return informer_factory
+
     def run(
         self,
         client: ClusterClient,
@@ -151,12 +186,10 @@ class Manager:
         """Start every registered controller plus the shared informers;
         with ``block=True`` (the reference's ``wg.Wait()``) returns only
         after ``stop`` fires and all controller threads exit."""
-        informer_factory = SharedInformerFactory(client, self._resync_period)
+        informer_factory = self.build(client, config, cloud_factory)
         threads = []
-        for name, init in new_controller_initializers().items():
+        for name, controller in self.controllers.items():
             klog.infof("Starting %s", name)
-            controller = init(client, informer_factory, config, cloud_factory)
-            self.controllers[name] = controller
             thread = threading.Thread(
                 target=controller.run, args=(stop,), daemon=True, name=name
             )
@@ -164,16 +197,7 @@ class Manager:
             threads.append(thread)
             klog.infof("Started %s", name)
 
-        gc_config = config.garbage_collector
-        if gc_config.interval > 0 and cloud_factory is not None:
-            # the sweeper shares the controllers' informer caches (its
-            # owner cross-checks must see the same world the reconciles
-            # do) and the same cloud factory (deletes flow through the
-            # shaped drivers); it never sweeps before those caches sync
-            self.gc = GarbageCollector(
-                informer_factory, gc_config, cloud_factory, health=self._health,
-                registry=self.metrics_registry,
-            )
+        if self.gc is not None:
             threading.Thread(
                 target=self.gc.run, args=(stop,), daemon=True,
                 name="garbage-collector",

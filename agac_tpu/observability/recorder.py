@@ -21,11 +21,10 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 from collections import deque
 from typing import Callable
 
-from .. import klog
+from .. import clockseam, klog
 
 DEFAULT_CAPACITY = 512
 
@@ -34,7 +33,7 @@ class FlightRecorder:
     def __init__(
         self,
         capacity: int = DEFAULT_CAPACITY,
-        clock: Callable[[], float] = time.time,
+        clock: Callable[[], float] = clockseam.time,
     ):
         self.capacity = max(1, capacity)
         self._clock = clock

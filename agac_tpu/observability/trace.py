@@ -16,17 +16,17 @@ gives the reconcile loop a lightweight tracer:
 Sampling is deterministic (every Nth trace per tracer, from the
 configured rate), so tests drive it without randomness and a fleet's
 sampled volume is exactly rate * traffic.  The clock is injectable;
-production uses ``time.monotonic``.
+the default reads the process clock seam (``clockseam.monotonic``),
+so spans run on virtual time under the simulation runtime.
 """
 
 from __future__ import annotations
 
 import json
 import threading
-import time
 from typing import Callable, Optional
 
-from .. import klog
+from .. import clockseam, klog
 
 
 class Span:
@@ -180,7 +180,7 @@ class Tracer:
     def __init__(
         self,
         sample_rate: float = 0.0,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = clockseam.monotonic,
         emit: Callable[[dict], None] = _default_emit,
     ):
         self._clock = clock

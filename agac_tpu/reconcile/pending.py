@@ -47,7 +47,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from .. import klog
+from .. import clockseam, klog
 from ..observability import instruments
 
 # what a group poller reports per token
@@ -115,10 +115,10 @@ class PendingSettleTable:
 
     def __init__(
         self,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
         registry=None,
     ):
-        self._clock = clock
+        self._clock = clock or clockseam.monotonic
         self._lock = threading.Lock()
         self._groups: dict[str, _GroupState] = {}
         # cumulative counters (stats() / bench export)
@@ -175,6 +175,16 @@ class PendingSettleTable:
         with self._lock:
             for state in self._groups.values():
                 state.entries.pop(key, None)
+
+    def reset(self) -> None:
+        """Drop EVERY parked entry without requeueing — process death
+        (the sim harness's leader kill, the kill drills): the table is
+        in-memory only and is rebuilt from requeue by the next
+        generation's relist, so entries referencing a dead generation's
+        queues must not be polled on its behalf."""
+        with self._lock:
+            for state in self._groups.values():
+                state.entries.clear()
 
     # ------------------------------------------------------------------
     # the poll tick

@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Any, Callable
 
-from .. import klog
+from .. import clockseam, klog
 from ..cloudprovider.aws import health as api_health
 from ..errors import NoRetryError, NotFoundError, is_no_retry
 from ..observability import instruments, recorder, trace
@@ -163,14 +163,14 @@ def _reconcile_handler(
         queue.forget(key)
         klog.errorf("expected string in workqueue but got %r", key)
         return
-    start = time.monotonic()
+    start = clockseam.monotonic()
     try:
         with trace.span("sync"):
             res, err = _dispatch(
                 key, key_to_obj, process_delete, process_create_or_update
             )
     finally:
-        elapsed = time.monotonic() - start
+        elapsed = clockseam.monotonic() - start
         klog.v(4).infof("Finished syncing %r (%.3fs)", key, elapsed)
     if _sync_duration_observers:
         _observe_sync_duration(key, elapsed, err)

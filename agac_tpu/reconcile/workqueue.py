@@ -26,6 +26,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Hashable, Optional
 
+from .. import clockseam
 from ..analysis import racecheck
 from ..observability import instruments
 
@@ -73,12 +74,14 @@ class BucketRateLimiter:
         self,
         qps: float = 10.0,
         burst: int = 100,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self._qps = qps
         self._burst = burst
         self._tokens = float(burst)
-        self._clock = clock
+        # default: the process clock seam (wall time in production,
+        # virtual time under the sim runtime — ISSUE 7)
+        self._clock = clock = clock or clockseam.monotonic
         self._last = clock()
         self._lock = threading.Lock()
 
@@ -140,7 +143,7 @@ def controller_rate_limiter(
     qps: float = 10.0,
     burst: int = 100,
     max_backoff: float = 1000.0,
-    clock: Callable[[], float] = time.monotonic,
+    clock: Optional[Callable[[], float]] = None,
 ) -> MaxOfRateLimiter:
     """The client-go default shape (per-item exponential + overall
     bucket) with a tunable bucket — the analog of passing a custom
@@ -181,11 +184,11 @@ class RateLimitingQueue:
         self,
         rate_limiter=None,
         name: str = "",
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
         metrics_registry=None,
     ):
         self.name = name
-        self._clock = clock
+        self._clock = clock or clockseam.monotonic
         self._limiter = rate_limiter or default_controller_rate_limiter()
         # the controller-runtime standard workqueue metric set, bound
         # to this queue's name label (observability plane, ISSUE 5)
@@ -212,10 +215,17 @@ class RateLimitingQueue:
         # delayed adds: heap of (ready_monotonic_time, seq, item)
         self._waiting: list = []
         self._seq = 0
-        self._waker = threading.Thread(
-            target=self._waiting_loop, daemon=True, name=f"workqueue-delay-{name}"
-        )
-        self._waker.start()
+        # the delay waker is a real thread ONLY when the runtime allows
+        # threads; under the sim runtime (ISSUE 7) delayed adds are
+        # popped synchronously by the cooperative scheduler via
+        # pop_due_delays()/kick_delays(), so every requeue interleaving
+        # is deterministic
+        self._waker: Optional[threading.Thread] = None
+        if clockseam.threads_enabled():
+            self._waker = threading.Thread(
+                target=self._waiting_loop, daemon=True, name=f"workqueue-delay-{name}"
+            )
+            self._waker.start()
 
     # ---- Type (dedup FIFO) ----
     def _add_locked(self, item: Hashable) -> None:
@@ -245,10 +255,10 @@ class RateLimitingQueue:
         # real wall clock on purpose, independent of the injected
         # delay clock: get() blocks a live worker thread, and a fake
         # delay clock must not turn a poll timeout into a hang
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout  # agac-lint: ignore[unseamed-clock] -- bounds a real blocked thread; a virtual clock here would turn the poll timeout into a hang
         with self._mutex:
             while not self._queue and not self._shutting_down:
-                remaining = None if deadline is None else deadline - time.monotonic()
+                remaining = None if deadline is None else deadline - time.monotonic()  # agac-lint: ignore[unseamed-clock] -- same real-thread timeout as above
                 if remaining is not None and remaining <= 0:
                     return None, False
                 self._ready.wait(remaining)
@@ -287,6 +297,13 @@ class RateLimitingQueue:
         with self._mutex:
             return len(self._queue)
 
+    def peek(self) -> Optional[Any]:
+        """The item ``get`` would hand out next, without claiming it
+        (the sim harness records it into the event trace before
+        stepping a worker)."""
+        with self._mutex:
+            return self._queue[0] if self._queue else None
+
     def shutdown(self) -> None:
         with self._mutex:
             self._shutting_down = True
@@ -312,17 +329,41 @@ class RateLimitingQueue:
     def kick_delays(self) -> None:
         """Wake the delay waker to re-examine the heap now — the seam
         fake-clock tests use after advancing their clock (a fake clock
-        cannot make ``Condition.wait`` return early)."""
+        cannot make ``Condition.wait`` return early).  In threadless
+        mode (sim runtime) there is no waker: the due items are popped
+        synchronously on the caller's thread instead."""
         with self._mutex:
-            self._delay.notify()
+            if self._waker is None:
+                self._pop_due_locked()
+            else:
+                self._delay.notify()
+
+    def pop_due_delays(self) -> None:
+        """Synchronously move every matured delayed add onto the ready
+        FIFO — the sim scheduler's explicit pump (equivalent to the
+        waker thread waking at the right moment, but on the
+        cooperative scheduler's own thread, in deterministic order)."""
+        with self._mutex:
+            self._pop_due_locked()
+
+    def next_delay_deadline(self) -> Optional[float]:
+        """The clock time at which the earliest delayed add matures
+        (None when nothing is parked) — how the sim scheduler knows
+        when this queue next becomes interesting."""
+        with self._mutex:
+            return self._waiting[0][0] if self._waiting else None
+
+    def _pop_due_locked(self) -> None:
+        now = self._clock()
+        while self._waiting and self._waiting[0][0] <= now:
+            _, _, item = heapq.heappop(self._waiting)
+            self._add_locked(item)
 
     def _waiting_loop(self) -> None:
         with self._mutex:
             while not self._shutting_down:
+                self._pop_due_locked()
                 now = self._clock()
-                while self._waiting and self._waiting[0][0] <= now:
-                    _, _, item = heapq.heappop(self._waiting)
-                    self._add_locked(item)
                 wait_for = (self._waiting[0][0] - now) if self._waiting else None
                 self._delay.wait(wait_for)
 

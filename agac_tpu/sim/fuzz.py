@@ -1,0 +1,515 @@
+"""Scenario fuzzer over the sim harness (ISSUE 7).
+
+One integer seed fully determines a scenario: the churn stream
+(create / delete / toggle-managed / flip-hostname / racing spec
+edits), the fault composition (throttle bursts × brownout outages ×
+ambiguous-commit chaos × one-shot crash faults × leader churn), and
+their timing — all drawn from one ``random.Random(seed)`` and played
+on the deterministic scheduler.  Same seed ⇒ identical event-trace
+hash ⇒ byte-identical replay, which is the whole debugging story: a
+CI failure artifact is just ``{seed, profile}``.
+
+After the active phase every fault is lifted, the world runs to
+quiescence, and the invariant-oracle battery (``sim/oracles.py``)
+plus the continuous GC/circuit oracles decide pass/fail.  The
+runtime race/lock-order watchdog (``analysis/racecheck.py``) is armed
+for the whole run.
+
+``canary=`` deliberately seeds a bug (used by the mutation test that
+proves the fuzzer CAN catch what it claims to catch):
+
+- ``drop-txt-delete`` — record cleanup "forgets" to delete owner TXT
+  records, splitting the atomic TXT+A pair: caught by the
+  record-atomicity and convergence oracles.
+- ``gc-stale-owner-cache`` — the GC sweeper's owner cross-check
+  trusts a (broken) cache claiming every owner absent, and the grace
+  period is disabled: live owners' accelerators get reaped — caught
+  by the live-owner deletion oracle and convergence.  (This is the
+  exact bug class the sweeper's apiserver re-verify rail and the
+  ``delete-without-ownership-check`` lint rule exist to prevent.)
+
+CLI (the CI ``sim`` job's corpus runner)::
+
+    python -m agac_tpu.sim.fuzz --seeds 1,2,3 --profile quick \
+        --artifacts artifacts/
+
+exits non-zero on any violation, writing one JSON artifact per
+failing seed (violations + trace tail + replay instructions).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import apis, klog
+from ..analysis import racecheck
+from ..cloudprovider.aws.health import GA_OPS, ROUTE53_OPS, HealthConfig
+from .harness import SimHarness, SimHarnessConfig
+from .oracles import CircuitBudgetOracle, GCDeletionOracle, standard_oracles
+
+# ops the brownout composition can black out, grouped by service
+_SERVICE_OPS = {
+    "route53": ROUTE53_OPS,
+    "globalaccelerator": GA_OPS,
+}
+
+# ops worth throttling / crashing (mutating chain + hot reads)
+_FAULTABLE_OPS = [
+    "create_accelerator", "update_accelerator", "delete_accelerator",
+    "create_listener", "create_endpoint_group", "add_endpoints",
+    "describe_accelerator", "list_accelerators",
+    "describe_load_balancers", "change_resource_record_sets",
+    "list_resource_record_sets", "list_hosted_zones",
+]
+
+_CRASHABLE_OPS = [
+    "create_accelerator", "update_accelerator", "delete_accelerator",
+    "create_listener", "create_endpoint_group",
+    "change_resource_record_sets",
+]
+
+CANARIES = ("drop-txt-delete", "gc-stale-owner-cache")
+
+
+@dataclass
+class FuzzProfile:
+    service_slots: int = 10
+    ingress_slots: int = 3
+    churn_ops: int = 60
+    # virtual length of the active (churn + faults) phase
+    active_seconds: float = 2400.0
+    heal_seconds: float = 7200.0
+    fault_compositions: int = 4
+    max_leader_churn: int = 2
+    chaos_budget: int = 0  # randomized retryable faults on every op
+    hostname_fraction: float = 0.4
+
+
+PROFILES = {
+    # tier-1 shape: one scenario in single-digit wall seconds, still
+    # big enough that every canary bug is observable (records exist
+    # and get deleted, GC sweeps run inside the active window)
+    "mini": FuzzProfile(
+        service_slots=6,
+        ingress_slots=0,
+        churn_ops=30,
+        active_seconds=900.0,
+        heal_seconds=3600.0,
+        fault_compositions=2,
+        max_leader_churn=1,
+        hostname_fraction=0.6,
+    ),
+    "quick": FuzzProfile(),
+    "deep": FuzzProfile(
+        service_slots=25,
+        ingress_slots=6,
+        churn_ops=220,
+        active_seconds=14400.0,
+        heal_seconds=14400.0,
+        fault_compositions=10,
+        max_leader_churn=4,
+        chaos_budget=25,
+    ),
+}
+
+
+@dataclass
+class ScenarioResult:
+    seed: int
+    profile: str
+    canary: Optional[str]
+    trace_hash: str
+    violations: list[str]
+    stats: dict
+    trace_tail: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def _nlb_hostname(i: int) -> str:
+    return f"lb{i}-0123456789abcdef.elb.us-west-2.amazonaws.com"
+
+
+def _install_canary(harness: SimHarness, canary: str) -> None:
+    if canary == "drop-txt-delete":
+        original = harness.aws.change_resource_record_sets
+
+        def buggy(zone_id, changes):
+            kept = [
+                change
+                for change in changes
+                if not (
+                    change.action == "DELETE"
+                    and change.record_set.type == "TXT"
+                )
+            ]
+            if not kept:
+                return None
+            return original(zone_id, kept)
+
+        # instance attribute shadows the class method; the backend's
+        # fault wrapper still applies on top
+        harness.aws.change_resource_record_sets = buggy
+    elif canary == "gc-stale-owner-cache":
+        # the sweeper's owner cross-check reads a broken cache that
+        # says every owner is gone, and grace is off: candidates are
+        # "confirmed" and deleted while their owners live
+        harness.controller_config.garbage_collector.grace_sweeps = 0
+
+        def break_owner_check(h, stack):
+            gc = stack.manager.gc
+            if gc is not None:
+                gc._owner_exists = lambda resource, ns, name: False
+
+        harness.on_stack_built = break_owner_check
+    else:
+        raise ValueError(f"unknown canary {canary!r} (have {CANARIES})")
+
+
+def _make_service(name: str, slot: int, hostname_annotated: bool):
+    from ..cluster import ObjectMeta, Service, ServicePort
+    from ..cluster.objects import LoadBalancerIngress, ServiceSpec
+
+    annotations = {
+        apis.AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+        apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+    }
+    if hostname_annotated:
+        annotations[apis.ROUTE53_HOSTNAME_ANNOTATION] = f"app{slot}.example.com"
+    svc = Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default", annotations=annotations
+        ),
+        spec=ServiceSpec(
+            type="LoadBalancer",
+            ports=[ServicePort(name="p80", port=80, protocol="TCP")],
+        ),
+    )
+    svc.status.load_balancer.ingress.append(
+        LoadBalancerIngress(hostname=_nlb_hostname(slot))
+    )
+    return svc
+
+
+def run_scenario(
+    seed: int,
+    profile: str = "quick",
+    canary: Optional[str] = None,
+) -> ScenarioResult:
+    """Play one fully seeded scenario; returns the oracle verdicts and
+    the replayable trace hash."""
+    shape = PROFILES[profile]
+    rng = random.Random(seed)
+    config = SimHarnessConfig(
+        replicas=2,
+        resync_period=600.0,
+        drift_tick_period=900.0,
+        gc_sweep_period=450.0,
+        gc_grace_sweeps=2,
+        health=HealthConfig(
+            window=30.0,
+            min_calls=6,
+            failure_ratio=0.5,
+            open_duration=15.0,
+            probe_budget=1,
+            aimd_qps=50.0,
+        ),
+        lease=_fast_lease(),
+    )
+    watchdog = racecheck.enable()
+    try:
+        with SimHarness(config=config) as harness:
+            for slot in range(shape.service_slots):
+                harness.aws.add_load_balancer(
+                    f"lb{slot}", "us-west-2", _nlb_hostname(slot)
+                )
+            harness.aws.add_hosted_zone("example.com")
+            if canary is not None:
+                _install_canary(harness, canary)
+            gc_oracle = GCDeletionOracle(config.cluster_name).attach(harness)
+            harness.run_for(15.0)  # leadership + initial sync
+            gc_oracle.prime()
+            if shape.chaos_budget:
+                harness.fault_plan.chaos(
+                    rng.randrange(1 << 30), shape.chaos_budget, p=0.08,
+                    ambiguous=0.3,
+                )
+
+            circuit_oracles: list[CircuitBudgetOracle] = []
+            harness.spawn(
+                _churn_actor(harness, rng, shape), "churn"
+            )
+            _schedule_faults(harness, rng, shape, circuit_oracles)
+
+            harness.run_for(shape.active_seconds)
+            # lift standing faults (outages + chaos); any scripted
+            # one-shots still queued fire as transients during heal
+            harness.fault_plan.restore()
+            harness.fault_plan.refill(0)
+            quiesced = harness.run_until_quiescent(
+                shape.heal_seconds, settle_window=3 * 60.0
+            )
+
+            violations = list(harness.violations)
+            if not quiesced:
+                violations.append(
+                    "quiescence: world still busy after "
+                    f"{shape.heal_seconds}s virtual heal window"
+                )
+            violations += standard_oracles(harness, config.cluster_name)
+            violations += gc_oracle.violations
+            for oracle in circuit_oracles:
+                violations += oracle.violations
+            try:
+                watchdog.assert_clean()
+            except AssertionError as err:
+                violations.append(f"racecheck: {err}")
+            return ScenarioResult(
+                seed=seed,
+                profile=profile,
+                canary=canary,
+                trace_hash=harness.trace_hash(),
+                violations=violations,
+                stats=harness.stats(),
+                trace_tail=list(harness.scheduler.trace_tail)[-200:],
+            )
+    finally:
+        racecheck.disable()
+
+
+def _fast_lease():
+    from ..leaderelection import LeaderElectionConfig
+
+    # production shape scaled to scenario length (lease churn must be
+    # observable inside the active window)
+    return LeaderElectionConfig(
+        lease_duration=60.0, renew_deadline=15.0, retry_period=5.0
+    )
+
+
+def _churn_actor(harness: SimHarness, rng: random.Random, shape: FuzzProfile):
+    """Generator actor: one cluster mutation per step, spaced by
+    seeded virtual delays.  Mixes creates, deletes, managed-annotation
+    toggles, hostname flips, no-op touches, and racing double-edits."""
+    live: dict[str, bool] = {}  # name -> hostname_annotated
+
+    def step():
+        slot = rng.randrange(shape.service_slots)
+        name = f"svc{slot}"
+        if name not in live:
+            hostname = rng.random() < shape.hostname_fraction
+            harness.cluster.create(
+                "Service", _make_service(name, slot, hostname)
+            )
+            live[name] = hostname
+            return
+        roll = rng.random()
+        if roll < 0.30:
+            harness.cluster.delete("Service", "default", name)
+            del live[name]
+        elif roll < 0.50:  # toggle managed off/on
+            obj = harness.cluster.get("Service", "default", name)
+            if apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION in obj.metadata.annotations:
+                obj.metadata.annotations.pop(
+                    apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+                )
+                obj.metadata.annotations.pop(apis.ROUTE53_HOSTNAME_ANNOTATION, None)
+                live[name] = False
+            else:
+                obj.metadata.annotations[
+                    apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+                ] = "true"
+            harness.cluster.update("Service", obj)
+        elif roll < 0.70:  # flip the route53 hostname annotation
+            obj = harness.cluster.get("Service", "default", name)
+            if apis.ROUTE53_HOSTNAME_ANNOTATION in obj.metadata.annotations:
+                obj.metadata.annotations.pop(apis.ROUTE53_HOSTNAME_ANNOTATION)
+                live[name] = False
+            elif (
+                apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+                in obj.metadata.annotations
+            ):
+                obj.metadata.annotations[
+                    apis.ROUTE53_HOSTNAME_ANNOTATION
+                ] = f"app{slot}.example.com"
+                live[name] = True
+            harness.cluster.update("Service", obj)
+        elif roll < 0.85:  # racing spec edits: two writes, same instant
+            obj = harness.cluster.get("Service", "default", name)
+            obj.metadata.labels["touched"] = str(rng.randrange(1 << 30))
+            harness.cluster.update("Service", obj)
+            obj = harness.cluster.get("Service", "default", name)
+            obj.metadata.labels["touched"] = str(rng.randrange(1 << 30))
+            harness.cluster.update("Service", obj)
+        else:  # plain touch
+            obj = harness.cluster.get("Service", "default", name)
+            obj.metadata.labels["touched"] = str(rng.randrange(1 << 30))
+            harness.cluster.update("Service", obj)
+
+    spacing = shape.active_seconds * 0.75 / max(shape.churn_ops, 1)
+    for _ in range(shape.churn_ops):
+        step()
+        yield rng.uniform(0.2 * spacing, 1.8 * spacing)
+
+
+def _schedule_faults(
+    harness: SimHarness,
+    rng: random.Random,
+    shape: FuzzProfile,
+    circuit_oracles: list,
+) -> None:
+    """Compose fault primitives across the active window."""
+    leader_churns = 0
+    for index in range(shape.fault_compositions):
+        at = rng.uniform(0.1, 0.8) * shape.active_seconds
+        kind = rng.choice(["throttle", "brownout", "crash", "leader", "hang"])
+        if kind == "throttle":
+            op = rng.choice(_FAULTABLE_OPS)
+            times = rng.randint(1, 5)
+            harness.after(
+                at,
+                lambda op=op, times=times: harness.fault_plan.throttle(
+                    op, times=times
+                ),
+                f"fault:throttle:{index}",
+            )
+        elif kind == "hang":
+            op = rng.choice(_FAULTABLE_OPS)
+            harness.after(
+                at,
+                lambda op=op: harness.fault_plan.hang_until_deadline(op),
+                f"fault:hang:{index}",
+            )
+        elif kind == "brownout":
+            service = rng.choice(sorted(_SERVICE_OPS))
+            window = rng.uniform(60.0, 240.0)
+            _schedule_brownout(
+                harness, at, service, window, circuit_oracles
+            )
+        elif kind == "crash":
+            op = rng.choice(_CRASHABLE_OPS)
+            when = rng.choice(["before", "after-commit"])
+            harness.after(
+                at,
+                lambda op=op, when=when: harness.fault_plan.crash(op, when=when),
+                f"fault:crash:{index}",
+            )
+        elif kind == "leader" and leader_churns < shape.max_leader_churn:
+            leader_churns += 1
+            graceful = rng.random() < 0.5
+
+            def churn(graceful=graceful):
+                if harness.leader() is None:
+                    return
+                if graceful:
+                    harness.demote_leader()
+                else:
+                    harness.kill_leader()
+
+            harness.after(at, churn, f"fault:leader:{index}")
+
+
+def _schedule_brownout(
+    harness: SimHarness,
+    at: float,
+    service: str,
+    window: float,
+    circuit_oracles: list,
+) -> None:
+    ops = _SERVICE_OPS[service]
+    oracle = CircuitBudgetOracle(harness, ops, service)
+    circuit_oracles.append(oracle)
+    health_config = harness.config.health
+
+    def start():
+        harness.fault_plan.outage(*sorted(ops))
+        harness.scheduler.record("fault", f"brownout:{service}")
+        # sample for the breaker trip a few times inside the window
+        for i in range(1, 6):
+            harness.after(
+                i * window / 6.0, sample, f"brownout-probe:{service}"
+            )
+
+    def sample():
+        if (
+            harness.health is not None
+            and harness.health.is_open(service)
+            and oracle._open_observed_at_call_index is None
+        ):
+            oracle.circuit_opened()
+
+    def end():
+        harness.fault_plan.restore(*sorted(ops))
+        harness.scheduler.record("fault", f"brownout-end:{service}")
+        if health_config is not None:
+            oracle.window_ended(
+                health_config.open_duration, window, health_config.probe_budget
+            )
+
+    harness.after(at, start, f"fault:brownout:{service}")
+    harness.after(at + window, end, f"fault:brownout-end:{service}")
+
+
+# ---------------------------------------------------------------------------
+# corpus runner (the CI `sim` job)
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+    import pathlib
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seeds", default="1,2,3,4,5")
+    parser.add_argument("--profile", default="quick", choices=sorted(PROFILES))
+    parser.add_argument("--canary", default=None, choices=CANARIES)
+    parser.add_argument("--artifacts", default=None)
+    args = parser.parse_args(argv)
+
+    failures = 0
+    for seed in [int(s) for s in args.seeds.split(",") if s]:
+        result = run_scenario(seed, profile=args.profile, canary=args.canary)
+        status = "ok" if result.ok else "FAIL"
+        print(
+            f"seed {seed} [{args.profile}] {status} "
+            f"trace={result.trace_hash[:16]} "
+            f"virtual={result.stats['virtual_time']}s "
+            f"calls={result.stats['aws_calls']}"
+        )
+        if not result.ok:
+            failures += 1
+            for violation in result.violations:
+                print(f"  - {violation}")
+            if args.artifacts:
+                directory = pathlib.Path(args.artifacts)
+                directory.mkdir(parents=True, exist_ok=True)
+                artifact = directory / f"seed-{seed}.json"
+                artifact.write_text(
+                    json.dumps(
+                        {
+                            "seed": seed,
+                            "profile": args.profile,
+                            "canary": result.canary,
+                            "trace_hash": result.trace_hash,
+                            "violations": result.violations,
+                            "stats": result.stats,
+                            "trace_tail": result.trace_tail,
+                            "replay": (
+                                "python -m agac_tpu.sim.fuzz "
+                                f"--seeds {seed} --profile {args.profile}"
+                            ),
+                        },
+                        indent=2,
+                    )
+                )
+                klog.infof("wrote %s", artifact)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

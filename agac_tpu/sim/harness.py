@@ -1,0 +1,744 @@
+"""SimHarness: the whole Manager on virtual time (ISSUE 7 tentpole).
+
+The harness assembles the SAME objects production runs — controllers
+via ``Manager.build`` (so a sim manager can never drift from a real
+one), the pending-settle table, the Route53 change batcher, the API
+health plane, the read-plane caches, the GC sweeper, and lease-based
+leader electors — but wires every clock to a ``SimScheduler`` and
+replaces every thread with a cooperative pump:
+
+- **informers** are driven by a non-blocking watch cursor
+  (``FakeCluster.events_since``) plus periodic relists
+  (``SharedInformer.sync_once``) on the resync timer; a trimmed-
+  history gap degrades to a relist exactly like a real 410 Gone;
+- **workers** are stepped one item at a time, round-robin over every
+  queue in construction order, after every scheduler event — the
+  deterministic ready-queue order of the cooperative executor;
+- **delayed requeues** (rate-limiter backoff, ``requeue_after``,
+  stage yields) sit in each queue's waiting heap; the harness asks
+  ``next_delay_deadline()`` and parks a wake event so virtual time
+  jumps straight to the next interesting instant;
+- **settle polls / drift ticks / GC sweeps / lease renewals /
+  resyncs** are recurring scheduler events driving the same
+  ``poll_once``/``drift_tick``/``gc_sweep``/``try_acquire_or_renew``
+  entry points tests and the bench already use;
+- **leader churn** is first-class: N contending electors over the
+  shared Lease object; ``kill_leader()`` drops the leading replica's
+  whole stack without releasing the lease (crash semantics — the
+  standby takes over a full lease_duration later), ``demote_leader()``
+  releases cleanly.  A new stack is built by whichever replica
+  acquires the lease, resynced from cluster + AWS state — the same
+  level-triggered recovery story the process drills prove.
+
+Every worker step, informer delta batch and timer firing folds into
+the scheduler's event-trace hash, so one seed ⇒ one interleaving ⇒
+one hash — the replay contract ``sim/fuzz.py`` builds on.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Generator, Optional
+
+from .. import klog
+from ..cloudprovider.aws import AWSDriver
+from ..cloudprovider.aws.batcher import ChangeBatcher
+from ..cloudprovider.aws.cache import (
+    AcceleratorTopologyCache,
+    DiscoveryCache,
+    HostedZoneCache,
+    LoadBalancerCoalescer,
+    RecordSetCache,
+)
+from ..cloudprovider.aws.fake_backend import (
+    FakeAWSBackend,
+    FaultPlan,
+    SimulatedCrash,
+)
+from ..cloudprovider.aws.health import (
+    ELBV2_OPS,
+    GA_OPS,
+    ROUTE53_OPS,
+    HealthConfig,
+    HealthTracker,
+)
+from ..cluster import FakeCluster, SharedInformerFactory
+from ..controllers import (
+    EndpointGroupBindingConfig,
+    GlobalAcceleratorConfig,
+    Route53Config,
+)
+from ..controllers.common import with_circuit_backoff
+from ..controllers.garbagecollector import GarbageCollectorConfig
+from ..leaderelection import LeaderElection, LeaderElectionConfig
+from ..manager import ControllerConfig, Manager
+from ..reconcile.pending import PendingSettleTable
+from ..reconcile.reconcile import process_next_work_item
+from . import runtime
+
+# a pump round that never quiesces within this many worker steps is a
+# livelock (an item requeueing itself with zero delay) — fail loudly
+# with the offending queues instead of spinning forever
+PUMP_STEP_LIMIT = 1_000_000
+
+
+@dataclass
+class SimHarnessConfig:
+    """Knobs for one simulated world.  Defaults favor scenario
+    realism at production-shaped timings — virtual time makes the
+    long constants free."""
+
+    cluster_name: str = "default"
+    replicas: int = 1
+    resync_period: float = 3600.0
+    settle_poll_interval: float = 1.0
+    drift_tick_period: float = 0.0  # 0 = off
+    gc_sweep_period: float = 0.0  # 0 = off
+    gc_grace_sweeps: int = 2
+    gc_max_deletes: int = 50
+    queue_qps: float = 0.0  # 0 = per-item backoff only
+    queue_burst: int = 100
+    queue_max_backoff: float = 8.0
+    reconcile_deadline: float = 0.0
+    # driver pacing (production constants; virtual seconds are free)
+    poll_interval: float = 10.0
+    poll_timeout: float = 180.0
+    lb_not_active_retry: float = 5.0
+    accelerator_missing_retry: float = 5.0
+    stage_requeue: float = 0.01
+    # async mutation pipeline
+    r53_batch_linger: float = 0.2
+    r53_batch_max: int = 100
+    # API health plane; None disables
+    health: Optional[HealthConfig] = None
+    # read plane TTLs
+    discovery_ttl: float = 30.0
+    discovery_tags_ttl: float = 300.0
+    zone_ttl: float = 60.0
+    read_plane_ttl: float = 15.0
+    topology_full_ttl: float = 900.0
+    # leader election (client-go's 60/15/5 shape by default)
+    lease: LeaderElectionConfig = field(
+        default_factory=lambda: LeaderElectionConfig(
+            lease_duration=60.0, renew_deadline=15.0, retry_period=5.0
+        )
+    )
+    # fake-backend shape when the harness builds it
+    quota_accelerators: int = 200
+    settle_describes: int = 2
+
+
+class _WorkerEntry:
+    """One queue's cooperative worker: the controller's own
+    ``worker_specs()`` entry, circuit-wrapped exactly like
+    ``run_workers`` would."""
+
+    __slots__ = (
+        "name", "queue", "key_to_obj", "process_delete",
+        "process_create_or_update", "on_sync_result", "reconcile_deadline",
+    )
+
+    def __init__(self, spec: dict):
+        self.name = spec["name"]
+        self.queue = spec["queue"]
+        self.key_to_obj = spec["key_to_obj"]
+        self.process_delete = with_circuit_backoff(spec["process_delete"])
+        self.process_create_or_update = with_circuit_backoff(
+            spec["process_create_or_update"]
+        )
+        self.on_sync_result = spec.get("on_sync_result")
+        self.reconcile_deadline = spec.get("reconcile_deadline") or None
+
+
+class _Stack:
+    """One controller-process generation: a Manager + informers +
+    worker entries, alive while its replica leads."""
+
+    def __init__(self, harness: "SimHarness", identity: str):
+        self.identity = identity
+        config = harness.controller_config
+        self.manager = Manager(
+            resync_period=harness.config.resync_period, health=harness.health
+        )
+        self.informer_factory = SharedInformerFactory(
+            harness.cluster,
+            harness.config.resync_period,
+            clock=harness.scheduler.monotonic,
+        )
+        self.manager.build(
+            harness.cluster, config, harness.cloud_factory, self.informer_factory
+        )
+        self.manager.settle_table = harness.settle_table
+        # initial list+sync, then per-informer watch cursors
+        self.cursors: dict = {}
+        for informer in self.informer_factory.informers():
+            self.cursors[informer] = informer.sync_once()
+        self.workers: list[_WorkerEntry] = [
+            _WorkerEntry(spec)
+            for controller in self.manager.controllers.values()
+            for spec in controller.worker_specs()
+        ]
+
+    def pump_informers(self, harness: "SimHarness") -> bool:
+        """Apply new cluster events to every informer and dispatch
+        handler deltas inline; True when anything moved."""
+        moved = False
+        for informer in self.informer_factory.informers():
+            events, cursor = harness.cluster.events_since(
+                informer.kind, self.cursors[informer]
+            )
+            if events is None:
+                # watch window trimmed (the 410 Gone analog): relist
+                self.cursors[informer] = informer.sync_once()
+                harness.scheduler.record("informer", f"{informer.kind}:relist")
+                moved = True
+                continue
+            for event in events:
+                informer.apply_event(event)
+            self.cursors[informer] = cursor
+            delivered = informer.drain_pending_deltas()
+            if events or delivered:
+                harness.scheduler.record(
+                    "informer", f"{informer.kind}:{len(events)}"
+                )
+                moved = True
+        return moved
+
+    def resync(self, harness: "SimHarness") -> None:
+        for informer in self.informer_factory.informers():
+            self.cursors[informer] = informer.sync_once()
+        harness.scheduler.record("informer", "resync")
+
+
+class _SimElector:
+    """Cooperative lease state machine over the real ``LeaderElection``
+    CAS logic — ticked every retry_period by the scheduler instead of
+    running acquire/renew threads."""
+
+    def __init__(self, harness: "SimHarness", identity: str):
+        self.harness = harness
+        self.identity = identity
+        self.elector = LeaderElection(
+            "agac-sim-controller",
+            "kube-system",
+            config=harness.config.lease,
+            identity=identity,
+            clock=harness.scheduler.monotonic,
+        )
+        self.leading = False
+        self.renew_deadline = 0.0
+        self.dead = False
+        self.event = harness.scheduler.every(
+            harness.config.lease.retry_period,
+            self.tick,
+            f"elector:{identity}",
+            first_after=0.0,
+        )
+
+    def tick(self) -> None:
+        if self.dead:
+            return
+        acquired, _holder = self.elector.try_acquire_or_renew(self.harness.cluster)
+        now = self.harness.scheduler.monotonic()
+        if not self.leading:
+            if acquired:
+                self.leading = True
+                self.renew_deadline = now + self.harness.config.lease.renew_deadline
+                self.elector.set_leading(True)
+                self.harness._on_leader_acquired(self)
+        elif acquired:
+            self.renew_deadline = now + self.harness.config.lease.renew_deadline
+            if self.harness._stack is None:
+                # we lead but no stack exists (a prior guard deferred
+                # the build while an old generation drained) — build now
+                self.harness._on_leader_acquired(self)
+        elif now >= self.renew_deadline:
+            self.leading = False
+            self.elector.set_leading(False)
+            self.harness._on_leader_lost(self)
+
+    def kill(self) -> None:
+        """Crash: stop participating WITHOUT releasing the lease."""
+        self.dead = True
+        self.leading = False
+        self.elector.set_leading(False)
+        self.event.cancel()
+
+    def release(self) -> None:
+        """Graceful shutdown: release the lease so a standby can
+        acquire on its next tick instead of waiting out the lease."""
+        self.dead = True
+        self.leading = False
+        self.elector.set_leading(False)
+        self.event.cancel()
+        self.elector._release(self.harness.cluster)
+
+
+class SimHarness:
+    """Context manager owning one simulated world.  Use::
+
+        with SimHarness(config=SimHarnessConfig(...)) as h:
+            h.cluster.create("Service", make_lb_service())
+            h.run_for(300.0)          # five virtual minutes
+            assert h.converged(...)
+    """
+
+    def __init__(
+        self,
+        cluster: Optional[FakeCluster] = None,
+        aws: Optional[FakeAWSBackend] = None,
+        config: Optional[SimHarnessConfig] = None,
+    ):
+        self.config = config or SimHarnessConfig()
+        self.scheduler = runtime.SimScheduler()
+        self._given_cluster = cluster
+        self._given_aws = aws
+        self._installed = False
+        self._stack: Optional[_Stack] = None
+        self._electors: list[_SimElector] = []
+        self._replica_serial = 0
+        self._queue_wake = None
+        self._pumping = False
+        self.generations = 0  # stacks built (leadership acquisitions)
+        self.violations: list[str] = []
+        # hooks the fuzzer uses: called around every GC sweep so
+        # continuous oracles can snapshot ownership immediately before
+        # the sweep and attribute each deletion to it precisely
+        # (anything deleted BETWEEN sweeps belongs to the ordinary
+        # reconcile paths, not the sweeper)
+        self.on_gc_sweep_begin: Optional[Callable] = None
+        self.on_gc_sweep: Optional[Callable] = None
+        # called with (harness, stack) after every generation build —
+        # scenario/canary customization point (each leadership
+        # acquisition builds a fresh stack, so per-instance patches
+        # must be re-applied)
+        self.on_stack_built: Optional[Callable] = None
+
+    # ------------------------------------------------------------------
+    # world construction (inside the installed seam)
+    # ------------------------------------------------------------------
+    def __enter__(self) -> "SimHarness":
+        from .. import clockseam
+
+        clock = self.scheduler.clock
+        clockseam.install(
+            monotonic=clock.monotonic,
+            wall=clock.time,
+            sleep=clock.sleep,
+            threads=False,
+        )
+        self._installed = True
+        config = self.config
+        self.cluster = self._given_cluster or FakeCluster()
+        if not hasattr(self.cluster, "events_since"):
+            raise TypeError(
+                "SimHarness needs a cluster with events_since (FakeCluster)"
+            )
+        self.aws = self._given_aws or FakeAWSBackend(
+            quota_accelerators=config.quota_accelerators,
+            settle_describes=config.settle_describes,
+        )
+        # fault plan with NO creator exemption: the harness thread IS
+        # every controller thread, so an exemption would exempt the
+        # whole world.  Oracle reads use the unfaulted helper methods.
+        if self.aws.fault_plan is None:
+            self.aws.install_fault_plan(FaultPlan(exempt_creator=False))
+        self.fault_plan = self.aws.fault_plan
+
+        self.health = (
+            HealthTracker(
+                config=config.health,
+                clock=self.scheduler.monotonic,
+                sleep=self.scheduler.clock.sleep,
+            )
+            if config.health is not None
+            else None
+        )
+        self.settle_table = PendingSettleTable(clock=self.scheduler.monotonic)
+        self.batcher = (
+            ChangeBatcher(
+                max_changes=config.r53_batch_max,
+                linger=config.r53_batch_linger,
+                clock=self.scheduler.monotonic,
+            )
+            if config.r53_batch_linger > 0
+            else None
+        )
+        # shared read-plane caches (seam-resolved clocks)
+        self._discovery = DiscoveryCache(
+            ttl=config.discovery_ttl,
+            tags_ttl=config.discovery_tags_ttl or None,
+            degraded=(
+                (lambda: self.health.is_open("globalaccelerator"))
+                if self.health is not None
+                else None
+            ),
+        )
+        self._zones = HostedZoneCache(ttl=config.zone_ttl)
+        self._topology = AcceleratorTopologyCache(
+            verify_ttl=config.read_plane_ttl, full_ttl=config.topology_full_ttl
+        )
+        self._records = RecordSetCache(
+            ttl=config.read_plane_ttl,
+            degraded=(
+                (lambda: self.health.is_open("route53"))
+                if self.health is not None
+                else None
+            ),
+        )
+        self._lb_coalescers: dict[str, LoadBalancerCoalescer] = {}
+
+        self.controller_config = ControllerConfig(
+            global_accelerator=GlobalAcceleratorConfig(
+                cluster_name=config.cluster_name,
+                queue_qps=config.queue_qps,
+                queue_burst=config.queue_burst,
+                queue_max_backoff=config.queue_max_backoff,
+                reconcile_deadline=config.reconcile_deadline,
+            ),
+            route53=Route53Config(
+                cluster_name=config.cluster_name,
+                queue_qps=config.queue_qps,
+                queue_burst=config.queue_burst,
+                queue_max_backoff=config.queue_max_backoff,
+                reconcile_deadline=config.reconcile_deadline,
+            ),
+            endpoint_group_binding=EndpointGroupBindingConfig(
+                queue_qps=config.queue_qps,
+                queue_burst=config.queue_burst,
+                queue_max_backoff=config.queue_max_backoff,
+                reconcile_deadline=config.reconcile_deadline,
+            ),
+            garbage_collector=GarbageCollectorConfig(
+                interval=config.gc_sweep_period,
+                grace_sweeps=config.gc_grace_sweeps,
+                max_deletes=config.gc_max_deletes,
+                cluster_name=config.cluster_name,
+            ),
+            settle_poll_interval=config.settle_poll_interval,
+        )
+
+        # recurring plumbing ticks (priority 1: after same-instant
+        # scenario actors, before nothing in particular — stable order)
+        self.scheduler.every(
+            config.settle_poll_interval, self._settle_tick, "settle-poll", priority=1
+        )
+        if config.drift_tick_period > 0:
+            self.scheduler.every(
+                config.drift_tick_period, self._drift_tick, "drift-tick", priority=1
+            )
+        if config.gc_sweep_period > 0:
+            self.scheduler.every(
+                config.gc_sweep_period, self._gc_tick, "gc-sweep", priority=1
+            )
+        self.scheduler.every(
+            config.resync_period, self._resync_tick, "informer-resync", priority=1
+        )
+        for _ in range(config.replicas):
+            self._add_replica()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from .. import clockseam
+
+        self._installed = False
+        clockseam.reset()
+
+    # ------------------------------------------------------------------
+    # cloud factory (the per-region driver production would build)
+    # ------------------------------------------------------------------
+    def cloud_factory(self, region: str) -> AWSDriver:
+        if self.health is not None:
+            ga = self.health.guard(self.aws, "globalaccelerator", GA_OPS)
+            elbv2 = self.health.guard(self.aws, f"elbv2[{region}]", ELBV2_OPS)
+            route53 = self.health.guard(self.aws, "route53", ROUTE53_OPS)
+        else:
+            ga = elbv2 = route53 = self.aws
+        coalescer = self._lb_coalescers.get(region)
+        if coalescer is None:
+            coalescer = self._lb_coalescers[region] = LoadBalancerCoalescer(
+                ttl=self.config.read_plane_ttl, batch_window=0.0
+            )
+        return AWSDriver(
+            ga,
+            elbv2,
+            route53,
+            poll_interval=self.config.poll_interval,
+            poll_timeout=self.config.poll_timeout,
+            sleep=self.scheduler.clock.sleep,
+            lb_not_active_retry=self.config.lb_not_active_retry,
+            accelerator_missing_retry=self.config.accelerator_missing_retry,
+            discovery_cache=self._discovery,
+            zone_cache=self._zones,
+            topology_cache=self._topology,
+            record_cache=self._records,
+            lb_coalescer=coalescer,
+            settle_table=self.settle_table,
+            change_batcher=self.batcher,
+            stage_requeue=self.config.stage_requeue,
+        )
+
+    # ------------------------------------------------------------------
+    # leadership
+    # ------------------------------------------------------------------
+    def _add_replica(self) -> _SimElector:
+        self._replica_serial += 1
+        elector = _SimElector(self, f"replica-{self._replica_serial}")
+        self._electors.append(elector)
+        return elector
+
+    def _on_leader_acquired(self, elector: _SimElector) -> None:
+        if self._stack is not None:
+            return  # split-brain guard: a live stack keeps running
+        klog.infof("sim: %s acquired leadership", elector.identity)
+        self.scheduler.record("leader", f"acquired:{elector.identity}")
+        self._stack = _Stack(self, elector.identity)
+        self.generations += 1
+        if self.on_stack_built is not None:
+            self.on_stack_built(self, self._stack)
+
+    def _on_leader_lost(self, elector: _SimElector) -> None:
+        if self._stack is not None and self._stack.identity == elector.identity:
+            self.scheduler.record("leader", f"lost:{elector.identity}")
+            self._drop_stack()
+
+    def _drop_stack(self) -> None:
+        self._stack = None
+        # in-memory only by doctrine: the next generation rebuilds the
+        # table from requeue (kill-mid-settle drill semantics)
+        self.settle_table.reset()
+
+    def leader(self) -> Optional[str]:
+        return self._stack.identity if self._stack is not None else None
+
+    def kill_leader(self) -> None:
+        """Hard-kill the leading replica: its stack vanishes, the
+        lease stays held — the standby (or a replacement replica)
+        takes over one lease_duration after the last renewal it
+        observed.  A replacement contender is added so the pool size
+        is preserved."""
+        for elector in self._electors:
+            if self._stack is not None and elector.identity == self._stack.identity:
+                self.scheduler.record("leader", f"killed:{elector.identity}")
+                elector.kill()
+                self._drop_stack()
+                self._add_replica()
+                return
+        raise RuntimeError("no leader to kill")
+
+    def _handle_crash(self, crash: SimulatedCrash) -> None:
+        klog.warningf("sim: %s — killing leader generation", crash)
+        self.scheduler.record("crash", f"{crash.op}:{crash.when}")
+        if self._stack is not None:
+            self.kill_leader()
+
+    def demote_leader(self) -> None:
+        """Gracefully stop the leading replica (lease released)."""
+        for elector in self._electors:
+            if self._stack is not None and elector.identity == self._stack.identity:
+                self.scheduler.record("leader", f"released:{elector.identity}")
+                elector.release()
+                self._drop_stack()
+                self._add_replica()
+                return
+        raise RuntimeError("no leader to demote")
+
+    # ------------------------------------------------------------------
+    # recurring plumbing ticks
+    # ------------------------------------------------------------------
+    def _settle_tick(self) -> None:
+        if self._stack is not None and self.settle_table.depth():
+            try:
+                self.settle_table.poll_once()
+            except SimulatedCrash as crash:
+                self._handle_crash(crash)
+
+    def _drift_tick(self) -> None:
+        if self._stack is not None:
+            try:
+                self._stack.manager.drift_tick()
+            except SimulatedCrash as crash:
+                self._handle_crash(crash)
+
+    def _gc_tick(self) -> None:
+        if self._stack is None or self._stack.manager.gc is None:
+            return
+        if self.on_gc_sweep_begin is not None:
+            self.on_gc_sweep_begin(self)
+        try:
+            report = self._stack.manager.gc_sweep()
+        except SimulatedCrash as crash:
+            self._handle_crash(crash)
+            return
+        if self.on_gc_sweep is not None:
+            self.on_gc_sweep(self, report)
+
+    def _resync_tick(self) -> None:
+        if self._stack is not None:
+            self._stack.resync(self)
+
+    # ------------------------------------------------------------------
+    # the cooperative executor
+    # ------------------------------------------------------------------
+    def _step_worker(self, entry: _WorkerEntry) -> None:
+        key = entry.queue.peek()
+        self.scheduler.record("work", f"{entry.name}:{key}")
+        thread = threading.current_thread()
+        original = thread.name
+        # the reconcile kernel derives its controller label (metrics,
+        # traces, heartbeats) from the worker thread's name
+        thread.name = f"{entry.name}-worker-0"
+        try:
+            process_next_work_item(
+                entry.queue,
+                entry.key_to_obj,
+                entry.process_delete,
+                entry.process_create_or_update,
+                entry.on_sync_result,
+                reconcile_deadline=entry.reconcile_deadline,
+            )
+        except SimulatedCrash as crash:
+            # the in-sim analog of os._exit(137): the leading
+            # "process" dies at this exact API boundary — its whole
+            # stack vanishes, the lease stays held, recovery is the
+            # standby's takeover + level-triggered resync
+            self._handle_crash(crash)
+        finally:
+            thread.name = original
+
+    def _pump(self) -> None:
+        """Drain everything runnable at the current virtual instant:
+        informer deltas, matured queue delays, and every ready work
+        item — one item per queue per round, round-robin, until
+        quiescent.  This is the cooperative thread-step executor; its
+        iteration order (informers in construction order, then queues
+        in construction order) IS the deterministic ready-queue
+        order."""
+        if self._pumping:
+            return  # re-entrancy guard (an actor stepping inside pump)
+        self._pumping = True
+        try:
+            steps = 0
+            while True:
+                stack = self._stack
+                progress = False
+                if stack is not None:
+                    progress |= stack.pump_informers(self)
+                    for entry in stack.workers:
+                        if self._stack is not stack:
+                            break  # a crash killed this generation
+                        entry.queue.pop_due_delays()
+                        if len(entry.queue):
+                            self._step_worker(entry)
+                            progress = True
+                            steps += 1
+                if not progress:
+                    return
+                if steps > PUMP_STEP_LIMIT:
+                    depths = {
+                        e.name: len(e.queue)
+                        for e in (stack.workers if stack else [])
+                    }
+                    raise RuntimeError(
+                        f"sim pump livelock: {steps} worker steps without "
+                        f"quiescing (queue depths {depths})"
+                    )
+        finally:
+            self._pumping = False
+
+    def _schedule_queue_wake(self) -> None:
+        if self._stack is None:
+            return
+        deadlines = [
+            deadline
+            for entry in self._stack.workers
+            if (deadline := entry.queue.next_delay_deadline()) is not None
+        ]
+        if not deadlines:
+            return
+        deadline = min(deadlines)
+        if self._queue_wake is not None and not self._queue_wake.cancelled:
+            if self._queue_wake.deadline <= deadline:
+                return
+            self._queue_wake.cancel()
+        self._queue_wake = self.scheduler.call_at(
+            deadline, lambda: None, "queue-wake", priority=2
+        )
+
+    # ------------------------------------------------------------------
+    # running
+    # ------------------------------------------------------------------
+    def run_until(self, deadline: float) -> None:
+        """Advance the world to virtual time ``deadline``."""
+        assert self._installed, "use `with SimHarness(...) as h:`"
+        while True:
+            self._pump()
+            self._schedule_queue_wake()
+            next_deadline = self.scheduler.next_deadline()
+            if next_deadline is None or next_deadline > deadline:
+                break
+            self.scheduler.step()
+        self.scheduler.advance_to(deadline)
+
+    def run_for(self, seconds: float) -> None:
+        self.run_until(self.scheduler.monotonic() + seconds)
+
+    def run_until_quiescent(
+        self, timeout: float, settle_window: float = 0.0
+    ) -> bool:
+        """Run until no queue holds ready OR delayed work, nothing is
+        parked in the settle table, and (optionally) a further
+        ``settle_window`` of virtual time passes without any AWS call
+        — or until ``timeout`` virtual seconds elapse.  Returns True
+        on quiescence."""
+        deadline = self.scheduler.monotonic() + timeout
+        while self.scheduler.monotonic() < deadline:
+            self._pump()
+            if not self._busy():
+                if settle_window <= 0:
+                    return True
+                calls_before = len(self.aws.calls)
+                self.run_for(settle_window)
+                if len(self.aws.calls) == calls_before and not self._busy():
+                    return True
+                continue
+            self._schedule_queue_wake()
+            next_deadline = self.scheduler.next_deadline()
+            if next_deadline is None or next_deadline > deadline:
+                break
+            self.scheduler.step()
+        return not self._busy()
+
+    def _busy(self) -> bool:
+        if self._stack is None:
+            return False
+        if self.settle_table.depth():
+            return True
+        for entry in self._stack.workers:
+            if len(entry.queue) or entry.queue.next_delay_deadline() is not None:
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # scenario actors + trace
+    # ------------------------------------------------------------------
+    def spawn(self, gen: Generator[float, None, None], name: str) -> None:
+        self.scheduler.spawn(gen, name)
+
+    def after(self, delay: float, fn: Callable[[], None], name: str) -> None:
+        self.scheduler.call_after(delay, fn, name)
+
+    def trace_hash(self) -> str:
+        return self.scheduler.trace_hash()
+
+    def stats(self) -> dict:
+        return {
+            "virtual_time": round(self.scheduler.monotonic(), 3),
+            "events": self.scheduler.events_dispatched,
+            "aws_calls": len(self.aws.calls),
+            "generations": self.generations,
+            "leader": self.leader(),
+            "settle": self.settle_table.stats(),
+            "batcher": self.batcher.stats() if self.batcher else None,
+        }

@@ -1,0 +1,310 @@
+"""Invariant oracles for simulated scenarios (ISSUE 7).
+
+Each oracle returns a list of violation strings (empty = clean), so a
+scenario can accumulate every broken invariant instead of dying on
+the first.  The final-state oracles assume the scenario has reached
+quiescence (faults cleared, queues drained); the continuous oracles
+(`gc_deletion_oracle`) hook into the harness mid-run, at the moment a
+deletion decision lands.
+
+The invariant list (the ISSUE's acceptance contract):
+
+- **no orphan deletion of live owners** — whenever the GC sweeper
+  deletes an accelerator or record owner, the Kubernetes owner object
+  did not exist at that instant (checked against the cluster, the
+  authority, synchronously inside the sweep event);
+- **ownership-TXT/record atomicity** — at quiescence, every managed
+  A-alias record has its owner TXT twin and vice versa: a half pair
+  means a crash/batch path split the atomic submission;
+- **pending-settle table drains** — nothing stays parked at
+  quiescence, and nothing expired without resolution during a healthy
+  (fault-free) run;
+- **circuit-open call budget** — while a service's circuit is open,
+  wire traffic to it is bounded by the half-open probe budget (no
+  retry storms into a brownout);
+- **eventual convergence to spec** — AWS state is exactly the image
+  of the final cluster state: one complete chain per managed object
+  with correct ownership, records matching surviving annotations,
+  nothing for deleted/unmanaged objects.
+"""
+
+from __future__ import annotations
+
+from .. import apis
+from ..cloudprovider.aws.driver import parse_route53_owner_value
+from ..controllers.globalaccelerator import is_managed_ingress, is_managed_service
+
+OWNER_TAG = "aws-global-accelerator-owner"
+RR_TYPE_A = "A"
+RR_TYPE_TXT = "TXT"
+
+
+# ---------------------------------------------------------------------------
+# expected state, derived from the cluster (the spec)
+# ---------------------------------------------------------------------------
+
+
+def expected_owners(cluster) -> set[str]:
+    """Owner-tag values that SHOULD have an accelerator chain."""
+    owners: set[str] = set()
+    services, _ = cluster.list("Service")
+    for svc in services:
+        if is_managed_service(svc) and svc.status.load_balancer.ingress:
+            owners.add(
+                f"service/{svc.metadata.namespace}/{svc.metadata.name}"
+            )
+    ingresses, _ = cluster.list("Ingress")
+    for ing in ingresses:
+        if is_managed_ingress(ing) and ing.status.load_balancer.ingress:
+            owners.add(
+                f"ingress/{ing.metadata.namespace}/{ing.metadata.name}"
+            )
+    return owners
+
+
+def expected_records(cluster) -> set[tuple[str, str]]:
+    """(record name, type) pairs that SHOULD exist across zones."""
+    records: set[tuple[str, str]] = set()
+    for kind in ("Service", "Ingress"):
+        objs, _ = cluster.list(kind)
+        for obj in objs:
+            hostnames = obj.metadata.annotations.get(
+                apis.ROUTE53_HOSTNAME_ANNOTATION, ""
+            )
+            if not hostnames or not obj.status.load_balancer.ingress:
+                continue
+            if kind == "Service" and not is_managed_service(obj):
+                # route53 records require the accelerator to exist;
+                # an unmanaged service keeps no records
+                continue
+            for hostname in filter(None, hostnames.split(",")):
+                records.add((hostname + ".", RR_TYPE_A))
+                records.add((hostname + ".", RR_TYPE_TXT))
+    return records
+
+
+# ---------------------------------------------------------------------------
+# final-state oracles
+# ---------------------------------------------------------------------------
+
+
+def check_convergence(harness) -> list[str]:
+    """AWS state == image of the cluster spec (complete chains, exact
+    owner set, exact record set)."""
+    violations = []
+    want_owners = expected_owners(harness.cluster)
+    have_owners = {
+        owner
+        for owner in harness.aws.accelerator_owners().values()
+        if owner is not None
+    }
+    missing = want_owners - have_owners
+    extra = have_owners - want_owners
+    if missing:
+        violations.append(f"convergence: accelerators missing for {sorted(missing)}")
+    if extra:
+        violations.append(f"convergence: orphan accelerators for {sorted(extra)}")
+    accelerators, listeners, endpoint_groups = harness.aws.chain_counts()
+    if not (accelerators == listeners == endpoint_groups == len(want_owners)):
+        violations.append(
+            "convergence: incomplete chains "
+            f"(accelerators={accelerators}, listeners={listeners}, "
+            f"endpoint_groups={endpoint_groups}, want={len(want_owners)})"
+        )
+    want_records = expected_records(harness.cluster)
+    have_records = {
+        (record.name, record.type)
+        for zone_id in harness.aws.all_hosted_zone_ids()
+        for record in harness.aws.records_in_zone(zone_id)
+        if record.type in (RR_TYPE_A, RR_TYPE_TXT)
+    }
+    if want_records != have_records:
+        violations.append(
+            f"convergence: records mismatch (missing "
+            f"{sorted(want_records - have_records)}, extra "
+            f"{sorted(have_records - want_records)})"
+        )
+    return violations
+
+
+def check_record_atomicity(harness, cluster_name: str = "default") -> list[str]:
+    """Every owner TXT has its A twin and vice versa — the atomic
+    TXT+A submission was never split by any fault path."""
+    violations = []
+    for zone_id in harness.aws.all_hosted_zone_ids():
+        records = harness.aws.records_in_zone(zone_id)
+        a_names = {r.name for r in records if r.type == RR_TYPE_A}
+        txt_names = set()
+        for record in records:
+            if record.type != RR_TYPE_TXT:
+                continue
+            values = [rr.value for rr in (record.resource_records or [])]
+            if any(
+                parse_route53_owner_value(v, cluster_name) is not None
+                for v in values
+            ):
+                txt_names.add(record.name)
+        for name in sorted(a_names - txt_names):
+            violations.append(
+                f"atomicity: A record {name!r} in {zone_id} has no owner TXT"
+            )
+        for name in sorted(txt_names - a_names):
+            violations.append(
+                f"atomicity: owner TXT {name!r} in {zone_id} has no A record"
+            )
+    return violations
+
+
+def check_settle_drained(harness) -> list[str]:
+    depth = harness.settle_table.depth()
+    if depth:
+        return [
+            "pending-settle: "
+            f"{depth} entries still parked at quiescence "
+            f"({harness.settle_table.depth_by_group()})"
+        ]
+    return []
+
+
+def check_no_residue(harness) -> list[str]:
+    """Every workqueue fully drained (ready AND delayed)."""
+    if harness._stack is None:
+        return []
+    violations = []
+    for entry in harness._stack.workers:
+        if len(entry.queue):
+            violations.append(f"residue: {entry.name} has ready items")
+        if entry.queue.next_delay_deadline() is not None:
+            violations.append(f"residue: {entry.name} has delayed items parked")
+    return violations
+
+
+def standard_oracles(harness, cluster_name: str = "default") -> list[str]:
+    """The full final-state battery."""
+    return (
+        check_convergence(harness)
+        + check_record_atomicity(harness, cluster_name)
+        + check_settle_drained(harness)
+        + check_no_residue(harness)
+    )
+
+
+# ---------------------------------------------------------------------------
+# continuous oracles
+# ---------------------------------------------------------------------------
+
+
+class GCDeletionOracle:
+    """No orphan deletion of live owners: snapshots accelerator/record
+    ownership before each sweep and verifies, for everything that
+    vanished during the sweep, that the owner object was absent from
+    the cluster at that moment.  Install via
+    ``harness.on_gc_sweep = oracle.after_sweep`` plus a pre-sweep
+    snapshot hook, or simply wrap ``attach(harness)``."""
+
+    def __init__(self, cluster_name: str = "default"):
+        self.cluster_name = cluster_name
+        self.violations: list[str] = []
+        self._harness = None
+
+    def attach(self, harness) -> "GCDeletionOracle":
+        self._harness = harness
+        harness.on_gc_sweep_begin = self._before_sweep
+        harness.on_gc_sweep = self._after_sweep
+        self._before: dict = {}
+        return self
+
+    def _before_sweep(self, harness) -> None:
+        # snapshot at the sweep boundary: deletions between sweeps are
+        # the ordinary reconcile paths' business, not the sweeper's
+        self._before["state"] = self._snapshot()
+
+    def _snapshot(self):
+        harness = self._harness
+        owners = {
+            owner
+            for owner in harness.aws.accelerator_owners().values()
+            if owner is not None
+        }
+        record_owners = set()
+        for zone_id in harness.aws.all_hosted_zone_ids():
+            for record in harness.aws.records_in_zone(zone_id):
+                if record.type != RR_TYPE_TXT:
+                    continue
+                for rr in record.resource_records or []:
+                    parsed = parse_route53_owner_value(rr.value, self.cluster_name)
+                    if parsed is not None:
+                        record_owners.add(parsed)
+        return owners, record_owners
+
+    def _owner_exists(self, resource: str, ns: str, name: str) -> bool:
+        kind = "Service" if resource == "service" else "Ingress"
+        try:
+            self._harness.cluster.get(kind, ns, name)
+            return True
+        except Exception:
+            return False
+
+    def _after_sweep(self, harness, report: dict) -> None:
+        owners_after, record_owners_after = self._snapshot()
+        before_owners, before_record_owners = self._before.pop(
+            "state", (owners_after, record_owners_after)
+        )
+        for owner in before_owners - owners_after:
+            parts = owner.split("/")
+            if len(parts) == 3 and self._owner_exists(*parts):
+                self.violations.append(
+                    f"gc: deleted accelerator for LIVE owner {owner!r} "
+                    f"(sweep {report.get('sweep')})"
+                )
+        for owner in before_record_owners - record_owners_after:
+            if self._owner_exists(*owner):
+                self.violations.append(
+                    f"gc: deleted records for LIVE owner {owner!r} "
+                    f"(sweep {report.get('sweep')})"
+                )
+
+    def prime(self) -> None:
+        """Take the initial snapshot (call once the world is built)."""
+        self._before = {"state": self._snapshot()}
+
+
+class CircuitBudgetOracle:
+    """While a circuit is open, wire calls to the dead service must
+    stay within the half-open probe budget — brownouts shed load
+    instead of feeding retry storms.  Used by scenarios that schedule
+    an outage window: call ``window_started``/``window_ended`` around
+    it and the oracle bounds the calls made *after* the breaker
+    opened."""
+
+    def __init__(self, harness, service_ops: frozenset, label: str):
+        self.harness = harness
+        self.service_ops = {self._camel(op) for op in service_ops}
+        self.label = label
+        self.violations: list[str] = []
+        self._open_observed_at_call_index = None
+
+    @staticmethod
+    def _camel(op: str) -> str:
+        return "".join(part.capitalize() for part in op.split("_"))
+
+    def _calls_to_service(self) -> int:
+        return sum(
+            1 for call in self.harness.aws.calls if call[0] in self.service_ops
+        )
+
+    def circuit_opened(self) -> None:
+        self._open_observed_at_call_index = self._calls_to_service()
+
+    def window_ended(self, open_duration: float, window: float, probe_budget: int):
+        if self._open_observed_at_call_index is None:
+            return  # breaker never opened — nothing to bound
+        made = self._calls_to_service() - self._open_observed_at_call_index
+        # one probe allowance per open_duration interval, plus slack
+        # for the transition calls racing the trip
+        allowed = probe_budget * (int(window / max(open_duration, 0.001)) + 2) + 5
+        if made > allowed:
+            self.violations.append(
+                f"circuit-budget: {made} calls to {self.label} while its "
+                f"circuit was open (allowed ~{allowed})"
+            )

@@ -733,6 +733,132 @@ class TestUnregisteredMetric:
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# unseamed-clock
+# ---------------------------------------------------------------------------
+
+
+class TestUnseamedClock:
+    def test_direct_sleep_fires_once(self):
+        v = only(
+            run(
+                "import time\n\ndef run_loop(self):\n    time.sleep(1.0)\n",
+                path="agac_tpu/manager.py",
+            ),
+            "unseamed-clock",
+        )
+        assert "time.sleep" in v.message and "clockseam" in v.message
+
+    def test_direct_monotonic_read_fires_once(self):
+        v = only(
+            run(
+                "import time\n\ndef age(self):\n    return time.monotonic() - self.t0\n",
+                path="agac_tpu/reconcile/pending.py",
+            ),
+            "unseamed-clock",
+        )
+        assert "time.monotonic" in v.message
+
+    def test_wall_clock_and_time_ns_fire(self):
+        violations = run(
+            """
+            import time
+
+            def stamp(self):
+                return time.time(), time.time_ns()
+            """,
+            path="agac_tpu/cluster/record.py",
+        )
+        assert [v.rule for v in violations] == ["unseamed-clock"] * 2
+
+    def test_threading_timer_fires_once(self):
+        v = only(
+            run(
+                "import threading\n\ndef arm(self):\n    threading.Timer(5.0, self.tick).start()\n",
+                path="agac_tpu/controllers/route53.py",
+            ),
+            "unseamed-clock",
+        )
+        assert "Timer" in v.message and "scheduler" in v.message
+
+    def test_from_import_aliases_fire(self):
+        violations = run(
+            """
+            from time import sleep as pause
+            from threading import Timer
+
+            def f(self):
+                pause(0.1)
+                Timer(1.0, f)
+            """,
+            path="agac_tpu/cluster/informer.py",
+        )
+        assert [v.rule for v in violations] == ["unseamed-clock"] * 2
+
+    def test_seam_and_injected_clock_are_clean(self):
+        assert (
+            run(
+                """
+                from .. import clockseam
+
+                def loop(self, clock=None):
+                    self._clock = clock or clockseam.monotonic
+                    clockseam.sleep(0.5)
+                    return self._clock()
+                """,
+                path="agac_tpu/cloudprovider/aws/health.py",
+            )
+            == []
+        )
+
+    def test_formatting_helpers_are_clean(self):
+        # strftime/gmtime render a timestamp they are handed — only
+        # clock READS and sleeps are seam business
+        assert (
+            run(
+                "import time\n\ndef iso(now):\n    return time.strftime('%Y', time.gmtime(now))\n",
+                path="agac_tpu/cluster/record.py",
+            )
+            == []
+        )
+
+    def test_real_io_edges_are_sanctioned(self):
+        # SigV4 signing and real-HTTP token expiry NEED the real wall
+        # clock; virtual time there would sign unusable requests
+        for path in (
+            "agac_tpu/cloudprovider/aws/sigv4.py",
+            "agac_tpu/cloudprovider/aws/real_backend.py",
+            "agac_tpu/cluster/rest.py",
+            "agac_tpu/cluster/testserver.py",
+            "agac_tpu/sim/runtime.py",
+            "agac_tpu/clockseam.py",
+        ):
+            assert (
+                run("import time\n\ndef f():\n    return time.time()\n", path=path)
+                == []
+            ), path
+
+    def test_tests_and_bench_are_out_of_scope(self):
+        # wall-clock tiers drive real threads on purpose
+        assert (
+            run(
+                "import time\n\ndef wait_until(p):\n    time.sleep(0.02)\n",
+                path="tests/test_resilience_e2e.py",
+            )
+            == []
+        )
+
+    def test_suppression_with_justification_holds(self):
+        assert (
+            run(
+                "import time\n\ndef pop(self):\n"
+                "    deadline = time.monotonic() + 1.0  # agac-lint: ignore[unseamed-clock] -- bounds a real blocked thread\n",
+                path="agac_tpu/reconcile/workqueue.py",
+            )
+            == []
+        )
+
+
 def test_rule_registry_ships_the_documented_rules():
     ids = {r.id for r in RULES}
     assert ids == {
@@ -746,6 +872,7 @@ def test_rule_registry_ships_the_documented_rules():
         "blocking-settle-in-worker",
         "delete-without-ownership-check",
         "unregistered-metric",
+        "unseamed-clock",
     }
 
 

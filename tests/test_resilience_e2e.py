@@ -30,6 +30,12 @@ from agac_tpu.manager import ControllerConfig, Manager
 
 from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
 
+# Wall-clock parity check for the virtual-time ports in
+# tests/test_sim_e2e.py (TestSimRestartResume / TestSimFaultInjection):
+# real threads and real sleeps keep honest what the cooperative
+# executor models.
+pytestmark = pytest.mark.slow
+
 POLL_TIMEOUT = 10.0
 
 

@@ -1,0 +1,554 @@
+"""Sim-harness ports of the resilience and soak e2e tiers (ISSUE 7).
+
+Every scenario in ``test_resilience_e2e.py`` / ``test_soak_e2e.py``
+that waits on real threads and real sleeps has a virtual-time twin
+here: the SAME manager stack (built by ``Manager.build``), the same
+fake cluster/AWS backends, but driven by the deterministic scheduler —
+so hours of virtual lease churn, settle polls and resyncs cost
+milliseconds of wall clock and every run replays byte-identically.
+The wall-clock originals stay behind ``-m slow`` as parity checks
+that the cooperative executor didn't paper over a real-thread bug.
+
+Also here: the scenario fuzzer's fixed-seed tier — a clean mini
+corpus, seed-replay identity, and the two canary mutation runs that
+prove the invariant oracles CATCH the bug classes they claim to
+(a fuzzer that never fails is indistinguishable from one that cannot).
+
+The 7-virtual-day soak at N=10k (leader churn + brownout + churn) is
+the acceptance drill for the whole runtime; it rides under ``-m
+slow`` because it spends real minutes, not because it sleeps.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from agac_tpu import apis
+from agac_tpu.analysis import racecheck
+from agac_tpu.cloudprovider.aws.driver import (
+    CLUSTER_TAG_KEY,
+    MANAGED_TAG_KEY,
+    OWNER_TAG_KEY,
+    TARGET_HOSTNAME_TAG_KEY,
+)
+from agac_tpu.cloudprovider.aws.health import HealthConfig
+from agac_tpu.cloudprovider.aws.types import Tag
+from agac_tpu.leaderelection import LeaderElectionConfig
+from agac_tpu.sim import fuzz
+from agac_tpu.sim.harness import SimHarness, SimHarnessConfig
+from agac_tpu.sim.oracles import standard_oracles
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+from .test_chaos_e2e import alb_hostname, chain_complete, nlb_hostname
+
+FAST_LEASE = LeaderElectionConfig(
+    lease_duration=60.0, renew_deadline=15.0, retry_period=5.0
+)
+
+
+def world_config(**overrides) -> SimHarnessConfig:
+    config = SimHarnessConfig(replicas=2, lease=FAST_LEASE, **overrides)
+    return config
+
+
+def converge(harness, timeout=3600.0) -> None:
+    """Run to quiescence (with a settle window) and fail loudly if the
+    world is still busy."""
+    harness.run_for(30.0)
+    assert harness.run_until_quiescent(timeout, settle_window=60.0), (
+        f"world still busy: {harness.stats()}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# resilience ports (wall-clock originals: test_resilience_e2e.py)
+# ---------------------------------------------------------------------------
+
+
+class TestSimRestartResume:
+    def test_service_created_before_any_leader_converges(self):
+        """Port of test_service_created_while_down_converges_after_
+        restart: the object exists before the first generation leads —
+        the initial list, not the missed watch event, is the trigger."""
+        with SimHarness(config=world_config()) as harness:
+            harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            harness.cluster.create("Service", make_lb_service())
+            assert harness.aws.all_accelerator_arns() == []
+            converge(harness)
+            assert len(harness.aws.all_accelerator_arns()) == 1
+
+    def test_service_created_during_leadership_gap_converges(self):
+        """Harder variant virtual time makes cheap: the leader is
+        hard-killed (lease NOT released), the Service appears while
+        nobody leads, and the standby's takeover — one lease_duration
+        later — picks it up from its fresh initial list."""
+        with SimHarness(config=world_config()) as harness:
+            harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            harness.run_for(20.0)
+            first = harness.leader()
+            assert first is not None
+            harness.kill_leader()
+            harness.cluster.create("Service", make_lb_service())
+            assert harness.leader() is None
+            # the lease must expire before the standby can take over
+            harness.run_for(FAST_LEASE.lease_duration + 2 * FAST_LEASE.retry_period)
+            assert harness.leader() not in (None, first)
+            converge(harness)
+            assert len(harness.aws.all_accelerator_arns()) == 1
+            assert harness.generations == 2
+
+    def test_cleanup_resumes_across_generations(self):
+        """Gen1 creates the chain; gen2 (fresh caches, fresh queues,
+        fresh settle table) tears it down when the annotation goes
+        away — state carries purely through cluster + AWS."""
+        with SimHarness(config=world_config()) as harness:
+            harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            harness.cluster.create("Service", make_lb_service())
+            converge(harness)
+            assert len(harness.aws.all_accelerator_arns()) == 1
+            harness.demote_leader()  # graceful: lease released
+            harness.run_for(2 * FAST_LEASE.retry_period)
+            assert harness.generations == 2
+
+            svc = harness.cluster.get("Service", "default", "web")
+            del svc.metadata.annotations[
+                apis.AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+            ]
+            harness.cluster.update("Service", svc)
+            converge(harness)
+            assert harness.aws.all_accelerator_arns() == []
+
+    def test_restart_repairs_half_created_chain(self):
+        """A bare owner-tagged accelerator (the torn state a crash
+        leaves) is adopted and completed, never duplicated."""
+        with SimHarness(config=world_config()) as harness:
+            harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            harness.aws.create_accelerator(
+                "service-default-web",
+                "IPV4",
+                True,
+                [
+                    Tag(MANAGED_TAG_KEY, "true"),
+                    Tag(OWNER_TAG_KEY, "service/default/web"),
+                    Tag(TARGET_HOSTNAME_TAG_KEY, NLB_HOSTNAME),
+                    Tag(CLUSTER_TAG_KEY, "default"),
+                ],
+            )
+            arn = harness.aws.all_accelerator_arns()[0]
+            assert harness.aws.list_listeners(arn, 100, None)[0] == []
+
+            harness.cluster.create("Service", make_lb_service())
+            converge(harness)
+            assert harness.aws.all_accelerator_arns() == [arn]
+            listeners, _ = harness.aws.list_listeners(arn, 100, None)
+            assert len(listeners) == 1
+            groups, _ = harness.aws.list_endpoint_groups(
+                listeners[0].listener_arn, 100, None
+            )
+            assert len(groups) == 1
+
+    def test_external_tamper_repaired_on_next_reconcile(self):
+        with SimHarness(config=world_config()) as harness:
+            harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            harness.cluster.create("Service", make_lb_service())
+            converge(harness)
+            arn = harness.aws.all_accelerator_arns()[0]
+            listeners, _ = harness.aws.list_listeners(arn, 100, None)
+            groups, _ = harness.aws.list_endpoint_groups(
+                listeners[0].listener_arn, 100, None
+            )
+            harness.aws.delete_endpoint_group(groups[0].endpoint_group_arn)
+
+            svc = harness.cluster.get("Service", "default", "web")
+            svc.metadata.labels["touched"] = "true"
+            harness.cluster.update("Service", svc)
+            converge(harness)
+            assert (
+                len(
+                    harness.aws.list_endpoint_groups(
+                        listeners[0].listener_arn, 100, None
+                    )[0]
+                )
+                == 1
+            )
+
+
+class TestSimFaultInjection:
+    def test_create_listener_throttled_then_converges(self):
+        with SimHarness(config=world_config()) as harness:
+            harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            harness.fault_plan.throttle("create_listener", times=2)
+            harness.cluster.create("Service", make_lb_service())
+            converge(harness)
+            arns = harness.aws.all_accelerator_arns()
+            assert len(arns) == 1
+            assert len(harness.aws.list_listeners(arns[0], 100, None)[0]) == 1
+            assert harness.fault_plan.faults_served == 2
+
+    def test_describe_lb_outage_retries_until_healthy(self):
+        with SimHarness(config=world_config()) as harness:
+            harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            harness.fault_plan.throttle("describe_load_balancers", times=3)
+            harness.cluster.create("Service", make_lb_service())
+            converge(harness)
+            assert len(harness.aws.all_accelerator_arns()) == 1
+            assert harness.fault_plan.faults_served == 3
+
+    def test_crash_mid_create_recovered_by_standby(self):
+        """A SimulatedCrash at the CreateListener boundary kills the
+        leading generation mid-chain (lease still held); the standby
+        takes over after lease expiry and repairs the half-built
+        chain — the in-sim twin of the rc-137 process drills."""
+        with SimHarness(config=world_config()) as harness:
+            harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            harness.fault_plan.crash("create_listener", when="before")
+            harness.run_for(20.0)
+            harness.cluster.create("Service", make_lb_service())
+            converge(harness, timeout=7200.0)
+            assert harness.generations >= 2
+            arns = harness.aws.all_accelerator_arns()
+            assert len(arns) == 1
+            assert len(harness.aws.list_listeners(arns[0], 100, None)[0]) == 1
+            assert standard_oracles(harness) == []
+
+    def test_leader_failover_mid_fleet_converges(self):
+        """Kill the leader with half the fleet converged and more work
+        arriving; the next generation finishes without orphaning or
+        duplicating anything (port of the two-process failover
+        drill)."""
+        slots = 10
+        with SimHarness(
+            config=world_config(quota_accelerators=slots + 5)
+        ) as harness:
+            for i in range(slots):
+                harness.aws.add_load_balancer(
+                    f"lb{i}", NLB_REGION, nlb_hostname(i)
+                )
+            harness.aws.add_hosted_zone("example.com")
+            for i in range(slots // 2):
+                harness.cluster.create(
+                    "Service", fuzz._make_service(f"svc{i}", i, i % 3 == 0)
+                )
+            harness.run_for(90.0)  # mid-flight, not necessarily settled
+            harness.kill_leader()
+            for i in range(slots // 2, slots):
+                harness.cluster.create(
+                    "Service", fuzz._make_service(f"svc{i}", i, i % 3 == 0)
+                )
+            converge(harness, timeout=7200.0)
+            assert harness.generations == 2
+            assert standard_oracles(harness) == []
+
+
+# ---------------------------------------------------------------------------
+# soak port (wall-clock original: test_soak_e2e.py)
+# ---------------------------------------------------------------------------
+
+
+class TestSimSoakChurn:
+    def test_churn_then_convergence_quiescence_no_residue(self):
+        """The soak tier's three properties — convergence, zero-call
+        quiescence, no queue residue — under seeded Service+Ingress
+        churn, with the racecheck watchdog armed, in virtual time."""
+        n_service, n_ingress = 20, 6
+        rng = random.Random(20260729)
+        watchdog = racecheck.enable()
+        try:
+            with SimHarness(
+                config=world_config(
+                    resync_period=300.0,
+                    quota_accelerators=n_service + n_ingress + 10,
+                )
+            ) as harness:
+                zone = harness.aws.add_hosted_zone("example.com")
+                for i in range(n_service):
+                    harness.aws.add_load_balancer(
+                        f"lb{i}", NLB_REGION, nlb_hostname(i)
+                    )
+                for i in range(n_ingress):
+                    harness.aws.add_load_balancer(
+                        f"k8s-default-chaos{i}-0a1b2c3d4e",
+                        NLB_REGION,
+                        alb_hostname(i),
+                    )
+                harness.run_for(20.0)
+
+                from .fixtures import make_alb_ingress
+
+                live: dict[str, tuple] = {}
+
+                def churn_once():
+                    if rng.random() < 0.75:
+                        i = rng.randrange(n_service)
+                        name = f"svc{i}"
+                        if name not in live:
+                            harness.cluster.create(
+                                "Service",
+                                fuzz._make_service(name, i, rng.random() < 0.4),
+                            )
+                            live[name] = ("svc", i)
+                        elif rng.random() < 0.45:
+                            harness.cluster.delete("Service", "default", name)
+                            del live[name]
+                        else:
+                            obj = harness.cluster.get("Service", "default", name)
+                            obj.metadata.labels["touched"] = str(
+                                rng.randrange(1 << 30)
+                            )
+                            harness.cluster.update("Service", obj)
+                    else:
+                        i = rng.randrange(n_ingress)
+                        name = f"ing{i}"
+                        if name not in live:
+                            harness.cluster.create(
+                                "Ingress",
+                                make_alb_ingress(name=name, hostname=alb_hostname(i)),
+                            )
+                            live[name] = ("ing", i)
+                        elif rng.random() < 0.5:
+                            harness.cluster.delete("Ingress", "default", name)
+                            del live[name]
+                        else:
+                            obj = harness.cluster.get("Ingress", "default", name)
+                            obj.metadata.labels["touched"] = str(
+                                rng.randrange(1 << 30)
+                            )
+                            harness.cluster.update("Ingress", obj)
+
+                for _ in range(150):
+                    churn_once()
+                    harness.run_for(rng.uniform(1.0, 20.0))
+
+                # convergence + pending-settle drained + no residue
+                assert harness.run_until_quiescent(7200.0, settle_window=0.0)
+                assert standard_oracles(harness) == []
+
+                # zero-call quiescence across multiple resync periods
+                calls_before = len(harness.aws.calls)
+                harness.run_for(3 * 300.0)
+                assert len(harness.aws.calls) == calls_before, (
+                    "steady state still touching AWS"
+                )
+
+                # per-owner chain integrity, exactly like the original
+                for name, (kind, i) in live.items():
+                    owner = (
+                        f"service/default/{name}"
+                        if kind == "svc"
+                        else f"ingress/default/{name}"
+                    )
+                    lb = nlb_hostname(i) if kind == "svc" else alb_hostname(i)
+                    assert chain_complete(harness.aws, owner, lb), owner
+            watchdog.assert_clean()
+        finally:
+            racecheck.disable()
+
+
+# ---------------------------------------------------------------------------
+# the fuzzer: fixed-seed corpus, replay identity, canary mutations
+# ---------------------------------------------------------------------------
+
+MINI_SEED = 3
+
+# hypothesis is optional here on purpose: CI installs it (test.yml),
+# but its absence must only skip the seed-sweep property below — never
+# the rest of this module (a module-level importorskip would silently
+# drop every sim port with it)
+try:
+    from hypothesis import given, settings, strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover
+    HAVE_HYPOTHESIS = False
+
+
+if HAVE_HYPOTHESIS:
+
+    class TestHypothesisSeedSweep:
+        @settings(max_examples=5, deadline=None, derandomize=True)
+        @given(seed=st.integers(min_value=0, max_value=2**31 - 1))
+        def test_seed_sweep_passes_oracles(self, seed):
+            """Hypothesis drives seed discovery; each drawn seed is a
+            fully deterministic scenario, so a failure here shrinks to
+            a minimal seed that replays byte-identically via the CLI."""
+            result = fuzz.run_scenario(seed, profile="mini")
+            assert result.ok, (
+                f"seed {seed} violated: {result.violations} — replay with "
+                f"`python -m agac_tpu.sim.fuzz --seeds {seed} --profile mini`"
+            )
+
+
+class TestScenarioFuzzer:
+
+    def test_mini_seed_passes_all_oracles(self):
+        result = fuzz.run_scenario(MINI_SEED, profile="mini")
+        assert result.ok, result.violations
+        assert result.stats["virtual_time"] > 900.0
+
+    def test_same_seed_replays_byte_identically(self):
+        first = fuzz.run_scenario(MINI_SEED, profile="mini")
+        second = fuzz.run_scenario(MINI_SEED, profile="mini")
+        assert first.trace_hash == second.trace_hash
+        assert first.stats["aws_calls"] == second.stats["aws_calls"]
+        assert first.violations == second.violations
+
+    def test_canary_drop_txt_delete_is_caught(self):
+        """Mutation run: cleanup that 'forgets' owner-TXT deletes must
+        trip the record-atomicity/convergence oracles, with a
+        replayable seed."""
+        result = fuzz.run_scenario(
+            MINI_SEED, profile="mini", canary="drop-txt-delete"
+        )
+        assert not result.ok
+        assert any(
+            "atomicity" in v or "convergence" in v for v in result.violations
+        ), result.violations
+        replay = fuzz.run_scenario(
+            MINI_SEED, profile="mini", canary="drop-txt-delete"
+        )
+        assert replay.trace_hash == result.trace_hash
+        assert replay.violations == result.violations
+
+    def test_canary_gc_stale_owner_cache_is_caught(self):
+        """Mutation run: a GC sweeper trusting a stale owner cache
+        (grace disabled) reaps live owners — the live-owner deletion
+        oracle must catch it."""
+        result = fuzz.run_scenario(
+            MINI_SEED, profile="mini", canary="gc-stale-owner-cache"
+        )
+        assert not result.ok
+        assert any("LIVE owner" in v or "convergence" in v for v in result.violations), (
+            result.violations
+        )
+
+    def test_cli_reports_failure_and_writes_artifact(self, tmp_path):
+        rc = fuzz.main(
+            [
+                "--seeds", str(MINI_SEED),
+                "--profile", "mini",
+                "--canary", "drop-txt-delete",
+                "--artifacts", str(tmp_path),
+            ]
+        )
+        assert rc == 1
+        artifact = tmp_path / f"seed-{MINI_SEED}.json"
+        assert artifact.exists()
+        payload = artifact.read_text()
+        assert "trace_hash" in payload and "replay" in payload
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: 7 virtual days, N=10k, composed degradation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestSevenDaySoak:
+    def test_seven_virtual_days_at_10k_under_ten_minutes(self):
+        n = 10_000
+        start_wall = time.monotonic()
+        config = SimHarnessConfig(
+            replicas=2,
+            resync_period=6 * 3600.0,
+            drift_tick_period=6 * 3600.0,
+            gc_sweep_period=12 * 3600.0,
+            settle_poll_interval=30.0,
+            # production-shaped snapshot TTL: local writes are
+            # write-through, so a 30 s TTL at N=10k only buys extra
+            # full reloads (drift detection belongs to drift ticks)
+            discovery_ttl=300.0,
+            quota_accelerators=n + 50,
+            health=HealthConfig(
+                window=60.0,
+                min_calls=6,
+                failure_ratio=0.5,
+                open_duration=30.0,
+                probe_budget=1,
+                aimd_qps=200.0,
+            ),
+            lease=LeaderElectionConfig(
+                lease_duration=120.0, renew_deadline=60.0, retry_period=30.0
+            ),
+        )
+        rng = random.Random(7)
+        with SimHarness(config=config) as harness:
+            for i in range(n):
+                harness.aws.add_load_balancer(
+                    f"lb{i}", NLB_REGION, nlb_hostname(i)
+                )
+            harness.aws.add_hosted_zone("example.com")
+
+            def creator():
+                # the whole fleet arrives across the first two virtual
+                # hours — a rollout, not a thundering herd
+                for i in range(n):
+                    harness.cluster.create(
+                        "Service",
+                        fuzz._make_service(f"svc{i}", i, i % 20 == 0),
+                    )
+                    yield 7200.0 / n
+
+            def churner():
+                # steady churn for the rest of the week
+                for _ in range(600):
+                    slot = rng.randrange(n)
+                    name = f"svc{slot}"
+                    try:
+                        obj = harness.cluster.get("Service", "default", name)
+                    except Exception:
+                        yield 600.0
+                        continue
+                    if rng.random() < 0.3:
+                        harness.cluster.delete("Service", "default", name)
+
+                        def recreate(slot=slot, name=name):
+                            harness.cluster.create(
+                                "Service",
+                                fuzz._make_service(name, slot, slot % 20 == 0),
+                            )
+
+                        harness.after(
+                            rng.uniform(600.0, 3600.0), recreate, f"recreate:{name}"
+                        )
+                    else:
+                        obj.metadata.labels["touched"] = str(rng.randrange(1 << 30))
+                        harness.cluster.update("Service", obj)
+                    yield rng.uniform(300.0, 1200.0)
+
+            harness.spawn(creator(), "creator")
+            harness.after(8 * 3600.0, lambda: harness.spawn(churner(), "churn"), "arm-churn")
+            # leader churn: a hard kill on day 2, a graceful demotion
+            # on day 4
+            harness.after(2 * 86400.0, harness.kill_leader, "kill-leader")
+            harness.after(4 * 86400.0, harness.demote_leader, "demote-leader")
+            # a 2-hour Route53 brownout on day 3
+            harness.after(
+                3 * 86400.0,
+                lambda: harness.fault_plan.outage(
+                    "change_resource_record_sets",
+                    "list_resource_record_sets",
+                    "list_hosted_zones",
+                ),
+                "brownout-start",
+            )
+            harness.after(
+                3 * 86400.0 + 2 * 3600.0,
+                lambda: harness.fault_plan.restore(),
+                "brownout-end",
+            )
+
+            harness.run_for(7 * 86400.0)
+            assert harness.run_until_quiescent(12 * 3600.0, settle_window=600.0), (
+                harness.stats()
+            )
+            violations = standard_oracles(harness)
+            assert violations == [], violations[:10]
+            assert harness.generations >= 3
+            stats = harness.stats()
+            assert stats["virtual_time"] >= 7 * 86400.0
+
+        wall = time.monotonic() - start_wall
+        assert wall < 600.0, f"7-day soak took {wall:.0f}s wall (budget 600s)"

@@ -42,6 +42,11 @@ from .fixtures import NLB_REGION, make_alb_ingress, make_lb_service
 from .test_chaos_e2e import alb_hostname, chain_complete, nlb_hostname
 from .test_resilience_e2e import wait_until
 
+# Wall-clock parity check for the virtual-time port in
+# tests/test_sim_e2e.py (TestSimSoakChurn): real threads and real
+# sleeps keep honest what the cooperative executor models.
+pytestmark = pytest.mark.slow
+
 N_SERVICE_SLOTS = 20
 N_INGRESS_SLOTS = 6
 CHURN_OPS = 400
