@@ -55,6 +55,18 @@ stack — the classes ruff's pyflakes-tier cannot express:
   worst bug this codebase can ship — destroying a live cluster's
   resources with no event trail.
 
+- ``cross-shard-sweep`` — GC sweeps and drift-tick enumeration paths
+  (``controllers/garbagecollector.py``'s ``_sweep_*`` phases,
+  ``manager.py``'s ``drift_tick``/``reshard_resync``, every
+  controller's ``drift_resync_sources``) must consult the shard
+  filter (ISSUE 8): these are the paths that enumerate the WHOLE
+  fleet, so one that forgets the ownership predicate silently makes
+  every replica work (or worse, sweep) every key — the exact
+  duplicate-mutation/foreign-deletion class sharding must exclude.
+  Single-shard deployments are covered by the same filter
+  (``OWNS_ALL``); a genuinely single-process enumeration path carries
+  a sanctioned suppression instead.
+
 Suppression: append ``# agac-lint: ignore[rule-id] -- justification``
 to the offending line.  The justification is mandatory.
 """
@@ -656,6 +668,64 @@ def check_delete_without_ownership_check(
                 "route the deletion through "
                 "verify_*_orphan_ownership(...) first",
             )
+
+
+# ---------------------------------------------------------------------------
+# cross-shard-sweep
+# ---------------------------------------------------------------------------
+
+# the fleet-enumeration entry points the sharding plane partitions: a
+# GC sweep phase, the manager's drift/reshard enumerations, and every
+# controller's drift re-enqueue wiring.  Anything matching here must
+# reference the shard filter somewhere in its body.
+_SHARD_SWEEP_FUNCTIONS = re.compile(
+    r"^(_sweep_\w+|drift_tick|reshard_resync|drift_resync_sources)$"
+)
+# what counts as consulting the filter: any name/attribute containing
+# "shard" (self._shards.owns..., self.shard_filter.token(), a `shards`
+# parameter) — the wiring idiom this repo standardizes on
+_SHARDISH = re.compile(r"shard", re.IGNORECASE)
+
+
+def _is_shard_enumeration_module(ctx: LintContext) -> bool:
+    if ctx.path.name == "manager.py":
+        return True
+    return "controllers" in ctx.path.parts
+
+
+@rule(
+    "cross-shard-sweep",
+    "GC/drift fleet-enumeration paths must consult the shard filter — "
+    "an unfiltered sweep makes every replica work (or sweep) every "
+    "key, the duplicate-mutation class sharding exists to exclude",
+)
+def check_cross_shard_sweep(
+    tree: ast.Module, ctx: LintContext
+) -> Iterator[Violation]:
+    if not _is_shard_enumeration_module(ctx):
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _SHARD_SWEEP_FUNCTIONS.match(fn.name):
+            continue
+        consults_filter = any(
+            (isinstance(node, ast.Attribute) and _SHARDISH.search(node.attr))
+            or (isinstance(node, ast.Name) and _SHARDISH.search(node.id))
+            for node in ast.walk(fn)
+        )
+        if consults_filter:
+            continue
+        yield Violation(
+            "cross-shard-sweep",
+            str(ctx.path),
+            fn.lineno,
+            f"{fn.name}() enumerates the fleet without consulting the "
+            "shard filter — gate the enumeration on the ownership "
+            "predicate (self._shards.owns(...) / shard_filter), or "
+            "suppress with justification if this path is genuinely "
+            "single-process",
+        )
 
 
 # ---------------------------------------------------------------------------

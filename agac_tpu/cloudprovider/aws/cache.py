@@ -691,6 +691,17 @@ class AcceleratorTopologyCache:
             else:
                 del self._entries[arn]
 
+    def invalidate_all(self) -> None:
+        """Drop every cached chain (sharding reshard: the adopted
+        keyspace was written by ANOTHER process, so every local
+        snapshot is suspect)."""
+        with self._lock:
+            for arn, entry in list(self._entries.items()):
+                if entry.journal is not None:
+                    entry.journal.append(("invalidate", None))
+                else:
+                    del self._entries[arn]
+
     def remove(self, arn: str) -> None:
         """The accelerator was deleted locally (same journal semantics
         as ``invalidate``; kept separate for intent at call sites)."""

@@ -430,15 +430,48 @@ def shared_fake_backend() -> FakeAWSBackend:
             # the kill-mid-settle process drill uses to exercise the
             # pending-settle path against a real controller process
             settle = int(os.environ.get("AGAC_FAKE_SETTLE", "0") or 0)
+            # AGAC_FAKE_LATENCY=S shapes every fake API call with S
+            # seconds of wire latency — the multi-process sharding
+            # bench's capacity model (worker pool x latency per
+            # process)
+            latency = float(os.environ.get("AGAC_FAKE_LATENCY", "0") or 0)
+            # AGAC_FAKE_QUOTA_ACCELERATORS raises the fake account's
+            # accelerator quota (default 20) the way a real account
+            # requests a quota increase — fleet-scale process drills
+            # and the sharding bench need hundreds
+            quota = int(os.environ.get("AGAC_FAKE_QUOTA_ACCELERATORS", "20") or 20)
             if state_path:
                 _fake_backend = FileBackedFakeAWSBackend(
-                    state_path, settle_describes=settle
+                    state_path, settle_describes=settle, latency=latency,
+                    quota_accelerators=quota,
                 )
             else:
-                _fake_backend = FakeAWSBackend(settle_describes=settle)
+                _fake_backend = FakeAWSBackend(
+                    settle_describes=settle, latency=latency,
+                    quota_accelerators=quota,
+                )
             _seed_from_environment(_fake_backend)
             _install_crash_plan(_fake_backend)
         return _fake_backend
+
+
+def invalidate_read_plane() -> None:
+    """Drop every process-wide read-plane snapshot (ISSUE 8): wired as
+    ``Manager.on_reshard``, so a replica adopting another process's
+    keyspace re-reads AWS instead of trusting snapshots taken before
+    the ownership change — a stale discovery snapshot at adoption time
+    means duplicate accelerators."""
+    with _lock:
+        discovery, zones = _discovery_cache, _zone_cache
+        topology, records = _topology_cache, _record_cache
+    if discovery is not None:
+        discovery.invalidate()
+    if zones is not None:
+        zones.invalidate()
+    if topology is not None:
+        topology.invalidate_all()
+    if records is not None:
+        records.invalidate_all()
 
 
 def read_plane_stats() -> dict:

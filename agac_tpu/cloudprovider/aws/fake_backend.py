@@ -434,6 +434,13 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
     def __init__(
         self,
         settle_describes: int = 0,
+        # per-call wire latency in seconds (0 = instant): the
+        # multi-process sharding bench (ISSUE 8) shapes real
+        # subprocesses with it so throughput is bound by each
+        # process's worker pool x latency — the capacity model
+        # sharding divides — instead of by raw fake-op speed.
+        # Sleeps go through the clock seam (virtual under the sim).
+        latency: float = 0.0,
         # the documented default service quotas; raise them the way a
         # real account requests quota increases (the bench's 1000-
         # accelerator fleet does)
@@ -455,6 +462,7 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
         lock = racecheck.make_rlock("fake-backend")
         self._lock = lock
         self.settle_describes = settle_describes
+        self.latency = max(0.0, latency)
         self.quota_accelerators = quota_accelerators
         self.quota_listeners_per_accelerator = quota_listeners_per_accelerator
         self.quota_port_ranges_per_listener = quota_port_ranges_per_listener
@@ -520,6 +528,15 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
             plan = state.get("fault_plan")
             if plan is not None:
                 attr = plan.wrap(name, attr)
+            latency = state.get("latency", 0.0)
+            if latency:
+                inner = attr
+
+                def paced(*args, __inner=inner, **kwargs):
+                    clockseam.sleep(latency)
+                    return __inner(*args, **kwargs)
+
+                attr = paced
         return attr
 
     # ------------------------------------------------------------------
@@ -1139,10 +1156,17 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
     ``crash(op, when="after-commit")`` fires only after the commit hit
     disk, matching a real backend's view of a dying client.
 
-    Single-writer by design: only the acting leader mutates AWS, so
-    concurrent whole-file writes are not arbitrated beyond atomic
-    replace (the leader-failover drill kills the old leader before the
-    standby starts mutating)."""
+    Multi-writer safe (ISSUE 8): sharded deployments run several
+    concurrently-live controller processes against one "account", so
+    every mutating op holds an interprocess ``flock`` on a sidecar
+    lock file across reload → apply → save.  The state file is then a
+    serialized op log — a committed mutation can never be clobbered by
+    a concurrent writer's stale whole-file write (the lost-update race
+    the old single-writer design tolerated because the leader-failover
+    drill killed the old leader before the standby mutated).  Reads
+    stay lock-free: atomic replace means a reload always sees a
+    complete snapshot, just possibly a stale one — exactly AWS's
+    read-after-write consistency model."""
 
     _SEED_HELPERS = frozenset(
         {"add_load_balancer", "add_hosted_zone", "set_load_balancer_state"}
@@ -1152,17 +1176,58 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
         super().__init__(**kwargs)
         self._state_path = str(state_path)
         self._state_stamp: Optional[tuple] = None
+        # interprocess mutation arbitration (see class docstring);
+        # thread-local depth makes driver orchestrations that issue
+        # several ops reentrancy-safe within one thread
+        self._ipc_lock_path = f"{self._state_path}.lock"
+        self._ipc_depth = threading.local()
         self._persist_hook = self._persisted
         self._reload_if_changed()
+
+    def _interprocess_write_lock(self):
+        backend = self
+
+        class _Held:
+            def __enter__(self):
+                depth = getattr(backend._ipc_depth, "value", 0)
+                backend._ipc_depth.value = depth + 1
+                if depth:
+                    self._f = None
+                    return self
+                import fcntl
+
+                self._f = open(backend._ipc_lock_path, "a+")
+                fcntl.flock(self._f, fcntl.LOCK_EX)
+                return self
+
+            def __exit__(self, *exc):
+                backend._ipc_depth.value -= 1
+                if self._f is not None:
+                    import fcntl
+
+                    fcntl.flock(self._f, fcntl.LOCK_UN)
+                    self._f.close()
+
+        return _Held()
 
     # -- the API-op seam (installed via _persist_hook) ------------------
     def _persisted(self, name: str, call):
         mutating = name.startswith(_MUTATING_PREFIXES)
 
         def synced(*args, **kwargs):
-            self._reload_if_changed()
-            result = call(*args, **kwargs)
-            if mutating:
+            if not mutating:
+                self._reload_if_changed()
+                return call(*args, **kwargs)
+            # serialize reload → apply → save across processes: the
+            # state file becomes an op log, never a lost update.  The
+            # reload is FORCED, not stamp-gated: stat stamps are not
+            # collision-proof here (mtime granularity, size ties, and
+            # immediate inode recycling under os.replace all observed
+            # on container filesystems), and a skipped reload in the
+            # write path clobbers the other process's committed ops.
+            with self._interprocess_write_lock():
+                self._reload_if_changed(force=True)
+                result = call(*args, **kwargs)
                 self._save()
             return result
 
@@ -1170,21 +1235,24 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
 
     # -- test helpers stay coherent across processes too ----------------
     def add_load_balancer(self, *args, **kwargs):
-        self._reload_if_changed()
-        lb = super().add_load_balancer(*args, **kwargs)
-        self._save()
+        with self._interprocess_write_lock():
+            self._reload_if_changed(force=True)
+            lb = super().add_load_balancer(*args, **kwargs)
+            self._save()
         return lb
 
     def add_hosted_zone(self, *args, **kwargs):
-        self._reload_if_changed()
-        zone = super().add_hosted_zone(*args, **kwargs)
-        self._save()
+        with self._interprocess_write_lock():
+            self._reload_if_changed(force=True)
+            zone = super().add_hosted_zone(*args, **kwargs)
+            self._save()
         return zone
 
     def set_load_balancer_state(self, *args, **kwargs):
-        self._reload_if_changed()
-        super().set_load_balancer_state(*args, **kwargs)
-        self._save()
+        with self._interprocess_write_lock():
+            self._reload_if_changed(force=True)
+            super().set_load_balancer_state(*args, **kwargs)
+            self._save()
 
     def records_in_zone(self, zone_id):
         self._reload_if_changed()
@@ -1349,7 +1417,12 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
             stat = os.stat(self._state_path)
         except FileNotFoundError:
             return None
-        return (stat.st_mtime_ns, stat.st_size)
+        # st_ino is the collision breaker: every _save replaces the
+        # file with a fresh inode, so two different states can never
+        # share a stamp even when mtime_ns granularity and byte size
+        # collide (the lost-update the sharded multi-writer drill
+        # caught — two processes' saves a few hundred µs apart)
+        return (stat.st_mtime_ns, stat.st_size, stat.st_ino)
 
     def _save(self) -> None:
         with self._lock:
@@ -1364,9 +1437,11 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
         os.replace(tmp, self._state_path)
         self._state_stamp = self._stat_stamp()
 
-    def _reload_if_changed(self) -> None:
+    def _reload_if_changed(self, force: bool = False) -> None:
         stamp = self._stat_stamp()
-        if stamp is None or stamp == self._state_stamp:
+        if stamp is None:
+            return
+        if stamp == self._state_stamp and not force:
             return
         with open(self._state_path) as f:
             data = json.load(f)

@@ -367,6 +367,24 @@ class AIMDLimiter:
         with self._lock:
             return self._rate
 
+    def ceiling(self) -> float:
+        with self._lock:
+            return self._ceiling
+
+    def set_ceiling(self, ceiling: float) -> None:
+        """Retune the additive-restore cap in place — the sharding
+        plane's quota-division seam (ISSUE 8): a replica owning k of N
+        shards runs each service at ``base_qps * k/N``, so the fleet's
+        aggregate ceiling never exceeds the global budget.  A cut takes
+        effect immediately (the live rate is clamped down); growth is
+        earned back additively through successes, like any AIMD
+        recovery."""
+        with self._lock:
+            self._ceiling = max(ceiling, self._floor)
+            if self._rate > self._ceiling:
+                self._rate = self._ceiling
+                self._bucket.set_qps(self._rate)
+
     def on_throttle(self) -> None:
         with self._lock:
             self._rate = max(self._floor, self._rate * self._decrease)
@@ -508,7 +526,17 @@ class ServiceHealth:
         snap = {"circuit": self.breaker.snapshot(), "outcomes": counters}
         if self.limiter is not None:
             snap["aimd_rate"] = round(self.limiter.rate(), 3)
+            snap["aimd_ceiling"] = round(self.limiter.ceiling(), 3)
         return snap
+
+    def set_quota_fraction(self, fraction: float) -> None:
+        """Scale this service's AIMD ceiling to a slice of the global
+        budget (sharding quota division).  Clamped at the limiter's
+        floor — a replica owning zero shards idles at floor qps, which
+        is why the fleet-aggregate bound is stated over shard OWNERS
+        (docs/operations.md "Horizontal sharding")."""
+        if self.limiter is not None:
+            self.limiter.set_ceiling(self._config.aimd_qps * fraction)
 
 
 def _api_op_names(*interfaces) -> frozenset[str]:
@@ -578,16 +606,40 @@ class HealthTracker:
         self.registry = registry if registry is not None else MetricsRegistry()
         self._lock = threading.Lock()
         self._services: dict[str, ServiceHealth] = {}
+        # the sharding plane's budget slice (ISSUE 8): 1.0 = the whole
+        # global budget (single-process semantics); a sharded replica
+        # runs at owned/shard_count, rebalanced on every membership
+        # change — services created later inherit the current fraction
+        self._quota_fraction = 1.0
 
     def service(self, name: str) -> ServiceHealth:
         with self._lock:
             health = self._services.get(name)
+            fraction = self._quota_fraction
             if health is None:
                 health = self._services[name] = ServiceHealth(
                     name, self.config, clock=self._clock, sleep=self._sleep,
                     registry=self.registry,
                 )
+                if fraction != 1.0:
+                    health.set_quota_fraction(fraction)
             return health
+
+    def set_quota_fraction(self, fraction: float) -> None:
+        """Divide the configured AIMD budget: every service ceiling
+        becomes ``aimd_qps * fraction``, now and for services created
+        later.  The shard membership's on-change hook drives this, so
+        budget follows lease ownership."""
+        with self._lock:
+            self._quota_fraction = max(0.0, min(1.0, fraction))
+            services = list(self._services.values())
+            fraction = self._quota_fraction
+        for service_health in services:
+            service_health.set_quota_fraction(fraction)
+
+    def quota_fraction(self) -> float:
+        with self._lock:
+            return self._quota_fraction
 
     def guard(self, inner, name: str, ops: frozenset[str] = ALL_OPS):
         return HealthGuardedAPI(inner, self.service(name), ops)

@@ -58,6 +58,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="Run without acquiring the leader lease (single-replica setups).",
     )
     controller.add_argument(
+        "--shard-count", type=int, default=1,
+        help="Horizontal sharding (ISSUE 8): partition the reconcile "
+        "keyspace over N shard leases (consistent hashing on "
+        "namespace/name) and run every replica concurrently — each "
+        "reconciles only the keys its held shards own, with the AWS "
+        "quota divided per shard. Replaces classic single-leader "
+        "election. 1 (default) disables: one active leader owns "
+        "everything.",
+    )
+    controller.add_argument(
+        "--shards-per-replica", type=int, default=0,
+        help="Most shard leases one replica may hold (0 = no cap). "
+        "Failover coverage requires (replicas-1) x shards-per-replica "
+        ">= shard-count; see docs/operations.md 'Horizontal sharding' "
+        "for the sizing math.",
+    )
+    controller.add_argument(
         "--queue-qps", type=float, default=10.0,
         help="Overall enqueue rate limit per workqueue (token bucket qps).",
     )
@@ -247,6 +264,7 @@ def run_controller(args) -> int:
     )
     from ..leaderelection import LeaderElection, LeaderElectionConfig
     from ..manager import ControllerConfig, Manager
+    from ..sharding import ShardingConfig
     from ..signals import setup_signal_handler
 
     kubeconfig = resolve_kubeconfig(args.kubeconfig)
@@ -261,6 +279,22 @@ def run_controller(args) -> int:
         return 1
 
     namespace = os.environ.get("POD_NAMESPACE") or "default"
+    # lease timing env overrides: the kill-recovery / leader-failover
+    # drills need sub-second takeover, production keeps the reference's
+    # 60/15/5 defaults.  Shared by the single-leader lease AND the
+    # per-shard leases.
+    lease_defaults = LeaderElectionConfig()
+    lease_config = LeaderElectionConfig(
+        lease_duration=float(
+            os.environ.get("AGAC_LEASE_DURATION", lease_defaults.lease_duration)
+        ),
+        renew_deadline=float(
+            os.environ.get("AGAC_LEASE_RENEW_DEADLINE", lease_defaults.renew_deadline)
+        ),
+        retry_period=float(
+            os.environ.get("AGAC_LEASE_RETRY_PERIOD", lease_defaults.retry_period)
+        ),
+    )
     queue_limits = {
         "queue_qps": args.queue_qps,
         "queue_burst": args.queue_burst,
@@ -285,6 +319,12 @@ def run_controller(args) -> int:
             dry_run=args.gc_dry_run,
             cluster_name=args.cluster_name,
         ),
+        sharding=ShardingConfig(
+            shard_count=args.shard_count,
+            shards_per_replica=args.shards_per_replica,
+            namespace=namespace,
+            lease=lease_config,
+        ),
     )
     stop = setup_signal_handler()
 
@@ -292,6 +332,7 @@ def run_controller(args) -> int:
         configure_api_health,
         configure_pipeline,
         configure_read_plane,
+        invalidate_read_plane,
         real_cloud_factory,
         settle_poll_interval,
         shared_health_tracker,
@@ -319,6 +360,8 @@ def run_controller(args) -> int:
     obs_trace.configure(args.trace_sample)
     tracker = shared_health_tracker()
     manager = Manager(health=tracker, metrics_registry=obs_metrics.registry())
+    # reshard adoptions re-read AWS through fresh snapshots (ISSUE 8)
+    manager.on_reshard = invalidate_read_plane
 
     import threading
 
@@ -326,7 +369,8 @@ def run_controller(args) -> int:
 
     if args.health_port > 0:
         health_server = make_health_server(
-            args.health_port, health=tracker, gc_status=manager.gc_status
+            args.health_port, health=tracker, gc_status=manager.gc_status,
+            shard_status=manager.shard_status,
         )
         threading.Thread(
             target=health_server.serve_forever, daemon=True, name="health-server"
@@ -335,7 +379,8 @@ def run_controller(args) -> int:
         # a dedicated scrape listener for deployments that separate
         # probe and metrics networks; same handler, same registry
         metrics_server = make_health_server(
-            args.metrics_port, health=tracker, gc_status=manager.gc_status
+            args.metrics_port, health=tracker, gc_status=manager.gc_status,
+            shard_status=manager.shard_status,
         )
         threading.Thread(
             target=metrics_server.serve_forever, daemon=True, name="metrics-server"
@@ -347,25 +392,23 @@ def run_controller(args) -> int:
             block=True, settle_table=shared_settle_table(),
         )
 
+    if args.shard_count > 1:
+        # sharded mode (ISSUE 8): every replica runs concurrently —
+        # the per-shard leases (manager's membership loop) decide who
+        # works which keys, so the single-leader lease would only
+        # serialize the fleet back down to one active process
+        klog.infof(
+            "sharded mode: %d shards, capacity %d/replica — classic "
+            "leader election disabled",
+            args.shard_count, args.shards_per_replica or args.shard_count,
+        )
+        run_manager(stop)
+        return 0
+
     if args.disable_leader_election:
         run_manager(stop)
         return 0
 
-    # lease timing env overrides: the kill-recovery / leader-failover
-    # drills need sub-second takeover, production keeps the reference's
-    # 60/15/5 defaults
-    defaults = LeaderElectionConfig()
-    lease_config = LeaderElectionConfig(
-        lease_duration=float(
-            os.environ.get("AGAC_LEASE_DURATION", defaults.lease_duration)
-        ),
-        renew_deadline=float(
-            os.environ.get("AGAC_LEASE_RENEW_DEADLINE", defaults.renew_deadline)
-        ),
-        retry_period=float(
-            os.environ.get("AGAC_LEASE_RETRY_PERIOD", defaults.retry_period)
-        ),
-    )
     election = LeaderElection(
         "aws-global-accelerator-controller", namespace, config=lease_config
     )

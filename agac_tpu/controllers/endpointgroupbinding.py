@@ -41,6 +41,7 @@ from ..cloudprovider.aws.errors import (
 from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
 from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
+from ..sharding import OWNS_ALL
 from .common import (
     CloudFactory,
     GLOBAL_REGION,
@@ -78,8 +79,12 @@ class EndpointGroupBindingController:
         informer_factory: SharedInformerFactory,
         config: EndpointGroupBindingConfig,
         cloud_factory: Optional[CloudFactory] = None,
+        shard_filter=None,
     ):
         self._client = client
+        # sharding ownership predicate (ISSUE 8); OWNS_ALL = the
+        # single-shard semantics every pre-sharding tier runs under
+        self._shards = shard_filter if shard_filter is not None else OWNS_ALL
         self._workers = config.workers
         self._drift_resync_period = config.drift_resync_period
         self._reconcile_deadline = config.reconcile_deadline
@@ -111,18 +116,22 @@ class EndpointGroupBindingController:
         self._enqueue(new)
 
     def _enqueue(self, obj) -> None:
-        self.workqueue.add_rate_limited(meta_namespace_key(obj))
+        key = meta_namespace_key(obj)
+        if not self._shards.owns_key(key):
+            return  # another shard's replica reconciles this key
+        self.workqueue.add_rate_limited(key)
 
     def drift_resync_sources(self) -> list:
         """The canonical ``[(lister, predicate, enqueue), ...]`` drift
         re-enqueue wiring — consumed by the in-process ticker and by
         external single-tick drivers (the bench's drift-tick
         measurement), so the two can never diverge."""
-        # every EndpointGroupBinding is managed (no annotation gate)
+        # every EndpointGroupBinding is managed (no annotation gate);
+        # the shard filter still partitions them across replicas
         return [
             (
                 self.binding_lister,
-                lambda b: True,
+                self._shards.owns_obj,
                 lambda b: self.workqueue.add(meta_namespace_key(b)),
             )
         ]

@@ -56,6 +56,8 @@ from ..cloudprovider.aws.driver import OWNER_TAG_KEY, accelerator_owner_tag_valu
 from ..errors import NotFoundError
 from ..observability import instruments, recorder
 from ..observability.metrics import MetricsRegistry
+from ..sharding import OWNS_ALL
+from ..sharding.reports import merge_shard_reports
 from .common import CloudFactory, GLOBAL_REGION
 
 CONTROLLER_AGENT_NAME = "garbage-collector"
@@ -123,10 +125,16 @@ class GarbageCollector:
         cloud_factory: CloudFactory,
         health=None,
         registry: "MetricsRegistry | None" = None,
+        shard_filter=None,
     ):
         self._config = config
         self._cloud = cloud_factory
         self._health = health
+        # sharding candidate partition (ISSUE 8): a sweeper only ever
+        # considers orphans whose owner key its shards own — no replica
+        # can sweep (or even grace-count) another shard's owners.
+        # OWNS_ALL = the single-sweeper-per-cluster semantics.
+        self._shards = shard_filter if shard_filter is not None else OWNS_ALL
         self._service_informer = informer_factory.informer("Service")
         self._ingress_informer = informer_factory.informer("Ingress")
         self._service_lister = self._service_informer.lister()
@@ -162,7 +170,10 @@ class GarbageCollector:
             lambda: len(self._pending_accelerators)
         )
         self._m_pending["records"].set_function(lambda: len(self._pending_records))
-        self.last_sweep_report: dict = {}
+        # per-shard partial reports keyed by ownership token (the
+        # single-owner-merge fix): a second sweeper's report lands in
+        # its own slot instead of silently overwriting the first
+        self.last_sweep_reports: dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     # apiserver cross-check
@@ -209,6 +220,9 @@ class GarbageCollector:
         module docstring."""
         config = self._config
         report = {
+            # the shard-ownership token this partial sweep covered
+            # ("all" in single-shard mode)
+            "shards": self._shards.token(),
             "dry_run": config.dry_run,
             "candidates": {"accelerators": 0, "records": 0},
             "grace_held": 0,
@@ -222,6 +236,13 @@ class GarbageCollector:
         }
         self._m_sweeps.inc()
         report["sweep"] = int(self._m_sweeps.value())
+        if not self._shards.owned_shards():
+            # a sharded replica holding no leases owns no keyspace:
+            # enumerating the fleet would spend quota to observe keys
+            # it may not touch — and no grace state may move either
+            report["skipped_no_shards"] = True
+            self._store_report(report)
+            return report
         if not self._synced():
             # an informer that has not listed yet makes EVERY owner
             # look absent — the one mistake this controller must never
@@ -254,6 +275,7 @@ class GarbageCollector:
         self._m_would_delete.inc(report["would_delete"])
         recorder.flight_recorder().record(
             "gc-sweep",
+            shards=report.get("shards"),
             sweep=report.get("sweep"),
             deleted=dict(report["deleted"]),
             candidates=dict(report["candidates"]),
@@ -261,7 +283,15 @@ class GarbageCollector:
             dry_run=report["dry_run"],
         )
         with self._lock:
-            self.last_sweep_report = report
+            self.last_sweep_reports[report["shards"]] = report
+
+    @property
+    def last_sweep_report(self) -> dict:
+        """The legacy single-report view: an additive merge over the
+        per-shard partials (identical to the raw report while one
+        sweeper covers the whole keyspace)."""
+        with self._lock:
+            return merge_shard_reports(self.last_sweep_reports)
 
     def _sweep_accelerators(self, cloud, report: dict, budget: list) -> None:
         if self._circuit_open("globalaccelerator"):
@@ -293,6 +323,10 @@ class GarbageCollector:
                     "gc sweep: %s has unparseable owner tag %r, skipping",
                     arn, owner_raw,
                 )
+                continue
+            if not self._shards.owns(owner[1], owner[2]):
+                # another shard's keyspace: not a candidate, and no
+                # grace state moves — its own sweeper observes it
                 continue
             if self._owner_exists(*owner):
                 if arn in pending:
@@ -350,6 +384,8 @@ class GarbageCollector:
         for owner in sorted(owners):
             if owner[0] not in _KNOWN_RESOURCES:
                 continue  # fail closed on foreign resource kinds
+            if not self._shards.owns(owner[1], owner[2]):
+                continue  # another shard's keyspace (see accelerators)
             if self._owner_exists(*owner):
                 if owner in pending:
                     report["adopted"] += 1
@@ -430,10 +466,18 @@ class GarbageCollector:
         """The /healthz + bench payload: config, cumulative totals,
         pending (grace-held) queue depths, and the last sweep's full
         counter set.  Totals are read FROM the registry children (the
-        single source /metrics also renders)."""
+        single source /metrics also renders).  ``last_sweep`` is the
+        merged view over per-shard partials; ``per_shard`` carries the
+        raw partial reports keyed by ownership token."""
         with self._lock:
-            last_sweep = dict(self.last_sweep_report)
+            per_shard = {
+                token: dict(report)
+                for token, report in self.last_sweep_reports.items()
+            }
+        last_sweep = merge_shard_reports(per_shard)
         return {
+            "shards": self._shards.token(),
+            "per_shard": per_shard,
             "enabled": True,
             "dry_run": self._config.dry_run,
             "interval": self._config.interval,

@@ -25,6 +25,7 @@ from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
 from ..errors import no_retry_errorf
 from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
+from ..sharding import OWNS_ALL
 from .common import (
     CloudFactory,
     GLOBAL_REGION,
@@ -80,8 +81,12 @@ class Route53Controller:
         informer_factory: SharedInformerFactory,
         config: Route53Config,
         cloud_factory: Optional[CloudFactory] = None,
+        shard_filter=None,
     ):
         self.cluster_name = config.cluster_name
+        # sharding ownership predicate (ISSUE 8); OWNS_ALL = the
+        # single-shard semantics every pre-sharding tier runs under
+        self._shards = shard_filter if shard_filter is not None else OWNS_ALL
         self._workers = config.workers
         self._drift_resync_period = config.drift_resync_period
         self._reconcile_deadline = config.reconcile_deadline
@@ -165,24 +170,27 @@ class Route53Controller:
             return
         self._enqueue(self.ingress_queue, ingress)
 
-    @staticmethod
-    def _enqueue(queue: RateLimitingQueue, obj) -> None:
-        queue.add_rate_limited(meta_namespace_key(obj))
+    def _enqueue(self, queue: RateLimitingQueue, obj) -> None:
+        key = meta_namespace_key(obj)
+        if not self._shards.owns_key(key):
+            return  # another shard's replica reconciles this key
+        queue.add_rate_limited(key)
 
     def drift_resync_sources(self) -> list:
         """The canonical ``[(lister, predicate, enqueue), ...]`` drift
         re-enqueue wiring — consumed by the in-process ticker and by
         external single-tick drivers (the bench's drift-tick
         measurement), so the two can never diverge."""
+        owns = self._shards.owns_obj  # shard-aware: foreign keys never tick
         return [
             (
                 self.service_lister,
-                is_hostname_managed_service,
+                lambda svc: is_hostname_managed_service(svc) and owns(svc),
                 lambda svc: self.service_queue.add(meta_namespace_key(svc)),
             ),
             (
                 self.ingress_lister,
-                is_hostname_managed_ingress,
+                lambda ing: is_hostname_managed_ingress(ing) and owns(ing),
                 lambda ing: self.ingress_queue.add(meta_namespace_key(ing)),
             ),
         ]

@@ -98,6 +98,15 @@ class LeaderElection:
     def is_leader(self) -> bool:
         return self._leading.is_set()
 
+    def observed_holder(self) -> str:
+        """The holder identity of the last lease record this elector
+        observed ("" before any observation) — shard membership uses
+        it to distinguish a steal from a first claim."""
+        with self._observed_lock:
+            if self._observed_record is None:
+                return ""
+            return self._observed_record[0] or ""
+
     def set_leading(self, leading: bool) -> None:
         """Flip the leading flag from a cooperative driver (sim
         elector actors own the acquire/renew state machine themselves;
@@ -247,6 +256,11 @@ class LeaderElection:
         except Exception as err:
             klog.errorf("error updating lease: %s", err)
             return False, holder
+
+    def release(self, client: ClusterClient) -> None:
+        """Public release for cooperative drivers (shard membership,
+        the sim electors): clear the holder on clean shutdown."""
+        self._release(client)
 
     def _release(self, client: ClusterClient) -> None:
         """ReleaseOnCancel analog: clear the holder on clean shutdown."""

@@ -53,6 +53,9 @@ from .controllers import (
     Route53Controller,
 )
 from .controllers.common import CloudFactory
+from .observability import instruments as obs_instruments
+from .sharding import OWNS_ALL, ShardMembership, ShardingConfig
+from .sharding.reports import merge_shard_reports
 
 INFORMER_RESYNC_PERIOD = 30.0
 
@@ -82,10 +85,20 @@ class ControllerConfig:
     # passed to Manager.run; the checks are cheap (one coalesced list
     # + in-memory peeks), so 1 s keeps resolve latency ~1 tick.
     settle_poll_interval: float = 1.0
+    # the horizontal sharding plane (ISSUE 8): shard_count > 1 runs
+    # this replica as one of several concurrently-live controllers,
+    # each owning the keys its shard leases cover
+    sharding: ShardingConfig = field(default_factory=ShardingConfig)
 
 
 InitFunc = Callable[
-    [ClusterClient, SharedInformerFactory, ControllerConfig, Optional[CloudFactory]],
+    [
+        ClusterClient,
+        SharedInformerFactory,
+        ControllerConfig,
+        Optional[CloudFactory],
+        object,  # shard filter (sharding.ShardFilter)
+    ],
     object,
 ]
 
@@ -93,14 +106,14 @@ InitFunc = Callable[
 def new_controller_initializers() -> dict[str, InitFunc]:
     """The controller registry (reference ``manager.go:34-40``)."""
     return {
-        "global-accelerator-controller": lambda client, informers, config, cloud: GlobalAcceleratorController(
-            client, informers, config.global_accelerator, cloud
+        "global-accelerator-controller": lambda client, informers, config, cloud, shards: GlobalAcceleratorController(
+            client, informers, config.global_accelerator, cloud, shard_filter=shards
         ),
-        "route53-controller": lambda client, informers, config, cloud: Route53Controller(
-            client, informers, config.route53, cloud
+        "route53-controller": lambda client, informers, config, cloud, shards: Route53Controller(
+            client, informers, config.route53, cloud, shard_filter=shards
         ),
-        "endpoint-group-binding-controller": lambda client, informers, config, cloud: EndpointGroupBindingController(
-            client, informers, config.endpoint_group_binding, cloud
+        "endpoint-group-binding-controller": lambda client, informers, config, cloud, shards: EndpointGroupBindingController(
+            client, informers, config.endpoint_group_binding, cloud, shard_filter=shards
         ),
     }
 
@@ -128,10 +141,27 @@ class Manager:
         self.controllers: dict[str, object] = {}
         # the shared informer factory build() wired (None until then)
         self.informer_factory: Optional[SharedInformerFactory] = None
-        # what the last drift_tick did, for bench_detail.json and tests:
-        # {"enqueued": {controller: n}, "skipped": {controller: [svc]},
-        #  "partial": bool}
-        self.last_drift_report: dict = {}
+        # per-shard drift reports keyed by ownership token ("all" in
+        # single-shard mode); the legacy ``last_drift_report`` view
+        # merges them additively so a second shard's tick can never
+        # silently overwrite the first (the single-owner-merge fix,
+        # ISSUE 8)
+        self.last_drift_reports: dict[str, dict] = {}
+        # the sharding plane (ISSUE 8), built by build() when
+        # config.sharding.shard_count > 1; the filter defaults to
+        # owns-everything single-shard semantics
+        self.shard_membership: Optional[ShardMembership] = None
+        self.shard_filter = OWNS_ALL
+        # set by the membership on-change hook; the shard loop performs
+        # the adopted-key resync once informers are synced
+        self._reshard_pending = False
+        # read-plane invalidation hook, called before every reshard
+        # resync: the adopted keyspace was written by ANOTHER process,
+        # so every local snapshot (discovery, topology, record sets,
+        # zones) is suspect — reconciling adopted keys through a stale
+        # cache creates DUPLICATE accelerators.  Wired by cmd/root
+        # (factory caches) and the sim harness (per-replica world).
+        self.on_reshard: Optional[Callable[[], None]] = None
         # the orphan GC sweeper (ISSUE 4), built by run() when its
         # interval is > 0; None = disabled (reference parity)
         self.gc: Optional[GarbageCollector] = None
@@ -158,9 +188,29 @@ class Manager:
             client, self._resync_period
         )
         self.informer_factory = informer_factory
+        if config.sharding.enabled:
+            # the membership must exist BEFORE the controllers: their
+            # informer handlers consult the filter from the first
+            # delivered event
+            self.shard_membership = ShardMembership(
+                config.sharding,
+                identity=config.sharding.identity or None,
+                registry=self.metrics_registry,
+                on_change=self._on_shard_change,
+            )
+            self.shard_filter = self.shard_membership.filter
+            obs_instruments.sharding_instruments(
+                self.metrics_registry
+            ).keys_owned.set_function(self._count_owned_keys)
+            if self._health is not None:
+                # budget follows ownership from the very start: a
+                # replica that has not acquired any shard yet paces at
+                # the floor, not the whole global budget
+                self._health.set_quota_fraction(0.0)
         for name, init in new_controller_initializers().items():
             self.controllers[name] = init(
-                client, informer_factory, config, cloud_factory
+                client, informer_factory, config, cloud_factory,
+                self.shard_filter,
             )
         gc_config = config.garbage_collector
         if gc_config.interval > 0 and cloud_factory is not None:
@@ -171,6 +221,7 @@ class Manager:
             self.gc = GarbageCollector(
                 informer_factory, gc_config, cloud_factory, health=self._health,
                 registry=self.metrics_registry,
+                shard_filter=self.shard_filter,
             )
         return informer_factory
 
@@ -201,6 +252,15 @@ class Manager:
             threading.Thread(
                 target=self.gc.run, args=(stop,), daemon=True,
                 name="garbage-collector",
+            ).start()
+
+        if self.shard_membership is not None:
+            # the sharding plane's lease loop (ISSUE 8): every replica
+            # runs it concurrently — shard leases, not the single
+            # leader lease, decide who works which keys
+            threading.Thread(
+                target=self._shard_loop, args=(client, stop), daemon=True,
+                name="shard-membership",
             ).start()
 
         if settle_table is not None and config.settle_poll_interval > 0:
@@ -242,6 +302,119 @@ class Manager:
                 f"; busy workers: {', '.join(wedged)}" if wedged else "",
             )
 
+    # ------------------------------------------------------------------
+    # sharding plane (ISSUE 8)
+    # ------------------------------------------------------------------
+    def _on_shard_change(self, membership: ShardMembership) -> None:
+        """Membership hook: quota follows ownership immediately; the
+        adopted-key resync is deferred to the shard loop (it needs
+        synced informer caches to enumerate)."""
+        if self._health is not None:
+            self._health.set_quota_fraction(membership.quota_fraction())
+        self._reshard_pending = True
+        obs_recorder.flight_recorder().record(
+            "shard-rebalance",
+            owned=sorted(membership.owned_shards()),
+            quota_fraction=round(membership.quota_fraction(), 4),
+        )
+
+    def shard_tick(self, client: ClusterClient) -> bool:
+        """One membership round plus (when ownership changed and the
+        informer caches are synced) the adopted-keyspace resync — the
+        cooperative entry point the threaded loop AND the sim harness
+        both drive, so the two runtimes cannot diverge on failover
+        semantics.  Returns True when the owned-shard set changed."""
+        if self.shard_membership is None:
+            return False
+        changed = self.shard_membership.tick(client)
+        if self._reshard_pending and self._informers_synced():
+            self._reshard_pending = False
+            self.reshard_resync()
+        return changed
+
+    def _informers_synced(self) -> bool:
+        if self.informer_factory is None:
+            return False
+        return all(
+            informer.has_synced()
+            for informer in self.informer_factory.informers()
+        )
+
+    def reshard_resync(self) -> int:
+        """Re-enqueue every managed object this replica's shards now
+        own — the level-triggered adoption path after a lease steal or
+        first acquisition (informer events never replay for keys whose
+        events were consumed by a dead replica).  The controllers' own
+        drift sources carry the shard predicate, so this can never
+        enqueue foreign keys."""
+        if self.on_reshard is not None:
+            # fresh reads for an adopted keyspace: another process
+            # wrote it, local snapshots would ensure duplicates
+            self.on_reshard()
+        enqueued = 0
+        for controller in self.controllers.values():
+            for lister, predicate, enqueue in controller.drift_resync_sources():
+                for obj in lister.list():
+                    if predicate(obj):
+                        enqueue(obj)
+                        enqueued += 1
+        klog.infof(
+            "shard resync: re-enqueued %d keys for shards %s",
+            enqueued, self.shard_filter.token(),
+        )
+        return enqueued
+
+    def _shard_loop(self, client: ClusterClient, stop: threading.Event) -> None:
+        membership = self.shard_membership
+        klog.infof(
+            "Starting shard membership (identity %s, %d shards, capacity %d)",
+            membership.identity, membership.config.shard_count,
+            membership.config.max_shards,
+        )
+        while not stop.is_set():
+            try:
+                self.shard_tick(client)
+            except Exception as err:  # a bad tick must not kill the loop
+                klog.errorf("shard tick failed: %s", err)
+            stop.wait(membership.config.lease.retry_period)
+        membership.release_all(client)
+        klog.info("Shutting down shard membership")
+
+    def shard_status(self) -> dict:
+        """Shard assignment for ``/healthz``: which leases this replica
+        holds, the observed map, and its quota slice."""
+        if self.shard_membership is None:
+            return {"enabled": False}
+        status = {"enabled": True}
+        status.update(self.shard_membership.shard_map())
+        status["quota_fraction"] = round(
+            self.shard_membership.quota_fraction(), 4
+        )
+        status["keys_owned"] = self._count_owned_keys()
+        return status
+
+    def _count_owned_keys(self) -> int:
+        """Managed Services + Ingresses owned by this replica's shards
+        (the ``agac_shard_keys_owned`` gauge's collection-time view)."""
+        if self.informer_factory is None:
+            return 0
+        from .controllers.globalaccelerator import (
+            is_managed_ingress,
+            is_managed_service,
+        )
+
+        count = 0
+        try:
+            for obj in self.informer_factory.informer("Service").lister().list():
+                if is_managed_service(obj) and self.shard_filter.owns_obj(obj):
+                    count += 1
+            for obj in self.informer_factory.informer("Ingress").lister().list():
+                if is_managed_ingress(obj) and self.shard_filter.owns_obj(obj):
+                    count += 1
+        except Exception:
+            return count
+        return count
+
     def drift_tick(self) -> int:
         """Drive ONE drift-resync round explicitly: walk every
         registered controller's own ``drift_resync_sources()`` — the
@@ -257,7 +430,14 @@ class Manager:
         marked partial in ``last_drift_report`` (exported into
         bench_detail.json), so a stale verify round is visibly stale
         rather than silently incomplete."""
-        report: dict = {"enqueued": {}, "skipped": {}, "partial": False}
+        report: dict = {
+            # the shard-ownership token this (possibly partial) tick
+            # covered — "all" in single-shard mode
+            "shards": self.shard_filter.token(),
+            "enqueued": {},
+            "skipped": {},
+            "partial": False,
+        }
         enqueued = 0
         for name, controller in self.controllers.items():
             open_services = (
@@ -285,14 +465,23 @@ class Manager:
                         count += 1
             report["enqueued"][name] = count
             enqueued += count
-        self.last_drift_report = report
+        self.last_drift_reports[report["shards"]] = report
         obs_recorder.flight_recorder().record(
             "drift-tick",
+            shards=report["shards"],
             enqueued=dict(report["enqueued"]),
             skipped=dict(report["skipped"]),
             partial=report["partial"],
         )
         return enqueued
+
+    @property
+    def last_drift_report(self) -> dict:
+        """The legacy single-report view: an additive merge over the
+        per-shard partials stored in ``last_drift_reports`` (identical
+        to the raw report while one replica covers the whole
+        keyspace)."""
+        return merge_shard_reports(self.last_drift_reports)
 
     def settle_tick(self) -> dict:
         """Drive ONE pending-settle poll round explicitly (tests and
@@ -371,6 +560,9 @@ class _HealthHandler(BaseHTTPRequestHandler):
             # dry-run rollout read would-delete counts here instead of
             # grepping logs
             "gc": self.server.gc_status(),
+            # shard assignment (ISSUE 8): which shard leases this
+            # replica holds, the observed map, and its quota slice
+            "sharding": self.server.shard_status(),
         }
         self._respond(500 if stuck else 200, body)
 
@@ -428,6 +620,7 @@ def make_health_server(
     gc_status: Optional[Callable[[], dict]] = None,
     metrics_registry: Optional["obs_metrics.MetricsRegistry"] = None,
     flight_recorder: Optional["obs_recorder.FlightRecorder"] = None,
+    shard_status: Optional[Callable[[], dict]] = None,
 ) -> ThreadingHTTPServer:
     """Build the manager's health endpoint (bind port 0 in tests);
     call ``serve_forever`` on a daemon thread to serve.  ``gc_status``
@@ -441,6 +634,7 @@ def make_health_server(
     server.heartbeats = heartbeats or api_health.worker_heartbeats()
     server.stuck_threshold = stuck_threshold
     server.gc_status = gc_status or (lambda: {"enabled": False})
+    server.shard_status = shard_status or (lambda: {"enabled": False})
     server.metrics_registry = (
         metrics_registry if metrics_registry is not None else obs_metrics.registry()
     )

@@ -1,0 +1,36 @@
+"""Horizontal sharding plane (ISSUE 8): partition the reconcile
+keyspace across multiple concurrently-live controller replicas.
+
+Three pieces, composed by the manager:
+
+- ``ring``: a deterministic consistent-hash partitioner over
+  ``namespace/name`` reconcile keys — a vnode ring, so resizing the
+  shard count moves ~1/N of the keyspace instead of reshuffling it;
+- ``membership``: per-shard Lease acquisition (N named leases
+  ``agac-shard-<i>``), generalized from the single active-passive
+  lease in ``leaderelection.py`` — each replica holds at most a
+  configured number of shards, steals expired leases, and publishes
+  the shard map it observes;
+- ``ShardFilter``: the ``owns(namespace, name)`` predicate every
+  enqueue funnel, drift tick and GC sweep consults, so a replica only
+  ever works keys its shards own.
+
+Swift (arxiv 2501.19051) is the reference shape: an elastic control
+plane that scales out without serializing through one coordinator.
+"""
+
+from .membership import (
+    OWNS_ALL,
+    ShardFilter,
+    ShardMembership,
+    ShardingConfig,
+)
+from .ring import HashRing
+
+__all__ = [
+    "HashRing",
+    "OWNS_ALL",
+    "ShardFilter",
+    "ShardMembership",
+    "ShardingConfig",
+]

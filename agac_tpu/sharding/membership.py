@@ -1,0 +1,311 @@
+"""Per-shard lease membership (ISSUE 8 tentpole, part b).
+
+``leaderelection.py`` coordinates ONE active replica through one
+Lease.  Sharding generalizes that to N named leases
+(``agac-shard-<i>``): every live replica contends for shard leases up
+to its configured capacity, renews what it holds, and steals leases
+whose holder stopped renewing — the same observed-record/local-clock
+freshness CAS the single-leader elector uses (one ``LeaderElection``
+per shard lease, so the two paths can never drift on lease
+semantics).
+
+Safety argument the exclusive-ownership oracle leans on:
+
+- a shard is claimed only through ``LeaderElection.try_acquire_or_renew``,
+  which refuses while the lease is *fresh* (held and renewed within
+  ``lease_duration`` on the local monotonic clock) — a live holder
+  renewing every ``retry_period`` is never stolen from;
+- a holder whose renew CAS fails (someone else stole an expired
+  lease) drops the shard from its owned set IMMEDIATELY, before the
+  next enqueue can consult the filter;
+- a replica over capacity releases the lease only AFTER dropping the
+  shard locally, so the next claimant can never overlap with it.
+
+Fairness is deliberately simple: at most ONE new shard is claimed per
+tick, so replicas that start together interleave their claims instead
+of the first one vacuuming the whole map.  Capacity
+(``shards_per_replica``) is the operator's failover-coverage knob —
+see docs/operations.md "Horizontal sharding" for the sizing math.
+
+Quota division rides on ownership: a replica's share of the global
+AWS budget is ``owned/shard_count`` (the manager feeds it to
+``HealthTracker.set_quota_fraction``).  Because owned sets are
+disjoint, the fleet's aggregate ceiling can never exceed the global
+budget — even mid-steal, when a shard's budget is briefly counted by
+nobody rather than twice.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .. import klog
+from ..leaderelection import LeaderElection, LeaderElectionConfig
+from ..observability import instruments
+from .ring import DEFAULT_VNODES, HashRing
+
+
+@dataclass
+class ShardingConfig:
+    # 1 (default) disables the sharding plane entirely: single-process
+    # semantics, every key owned, classic leader election untouched
+    shard_count: int = 1
+    # most shard leases one replica may hold; 0 = no cap (one survivor
+    # may adopt the whole keyspace).  Failover coverage requires
+    # (replicas - 1) * shards_per_replica >= shard_count.
+    shards_per_replica: int = 0
+    vnodes: int = DEFAULT_VNODES
+    namespace: str = "kube-system"
+    lease_prefix: str = "agac-shard"
+    lease: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
+    # lease holder identity; "" = a fresh uuid (production).  The sim
+    # harness injects stable names so replays stay byte-identical.
+    identity: str = ""
+
+    @property
+    def enabled(self) -> bool:
+        return self.shard_count > 1
+
+    @property
+    def max_shards(self) -> int:
+        if self.shards_per_replica <= 0:
+            return self.shard_count
+        return min(self.shards_per_replica, self.shard_count)
+
+
+class ShardFilter:
+    """The ownership predicate every enqueue funnel, drift source and
+    GC sweep consults.  ``owned`` is a live callable so the filter
+    tracks membership changes with no re-wiring."""
+
+    def __init__(
+        self,
+        ring: Optional[HashRing],
+        owned: Callable[[], frozenset[int]],
+    ):
+        self._ring = ring
+        self._owned = owned
+
+    @property
+    def all_shards(self) -> bool:
+        return self._ring is None
+
+    def owned_shards(self) -> frozenset[int]:
+        if self._ring is None:
+            return frozenset({0})
+        return self._owned()
+
+    def owns_key(self, key: str) -> bool:
+        if self._ring is None:
+            return True
+        return self._ring.shard_for_key(key) in self._owned()
+
+    def owns(self, namespace: str, name: str) -> bool:
+        if self._ring is None:
+            return True
+        return self._ring.shard_for(namespace, name) in self._owned()
+
+    def owns_obj(self, obj) -> bool:
+        return self.owns(obj.metadata.namespace, obj.metadata.name)
+
+    def token(self) -> str:
+        """A stable label for the current owned set — the per-shard
+        report key ``Manager.drift_tick`` / ``GarbageCollector`` store
+        partial results under (the single-owner-merge fix)."""
+        if self._ring is None:
+            return "all"
+        owned = sorted(self._owned())
+        return ",".join(map(str, owned)) if owned else "none"
+
+
+# single-shard mode: one process owns the whole keyspace (the
+# pre-sharding semantics every existing tier runs under)
+OWNS_ALL = ShardFilter(None, lambda: frozenset({0}))
+
+
+class ShardMembership:
+    """One replica's view of the N shard leases.
+
+    ``tick(client)`` is the cooperative entry point (the sim harness
+    schedules it; ``run`` wraps it in the threaded loop): renew owned
+    leases, drop lost ones, claim at most one unheld/expired lease
+    while below capacity, and refresh the observed shard map."""
+
+    def __init__(
+        self,
+        config: ShardingConfig,
+        identity: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
+        registry=None,
+        on_change: Optional[Callable[["ShardMembership"], None]] = None,
+    ):
+        self.config = config
+        self.ring = HashRing(config.shard_count, config.vnodes)
+        self._electors: dict[int, LeaderElection] = {}
+        first = LeaderElection(
+            f"{config.lease_prefix}-0", config.namespace,
+            config=config.lease, identity=identity, clock=clock,
+        )
+        self.identity = first.identity  # uuid unless injected
+        self._electors[0] = first
+        for shard in range(1, config.shard_count):
+            self._electors[shard] = LeaderElection(
+                f"{config.lease_prefix}-{shard}", config.namespace,
+                config=config.lease, identity=self.identity, clock=clock,
+            )
+        self._lock = threading.Lock()
+        self._owned: frozenset[int] = frozenset()
+        # last observed holder per shard (None = unheld/unknown) and a
+        # version that bumps whenever the observed assignment changes —
+        # the shard-map-version gauge
+        self._observed: dict[int, Optional[str]] = {
+            shard: None for shard in range(config.shard_count)
+        }
+        self.map_version = 0
+        self.on_change = on_change
+        self.filter = ShardFilter(self.ring, self.owned_shards)
+        metrics = instruments.sharding_instruments(registry)
+        for shard in range(config.shard_count):
+            metrics.lease_held.labels(shard=str(shard)).set_function(
+                self._held_view(shard)
+            )
+        metrics.map_version.set_function(lambda: float(self.map_version))
+        self._m_steals = metrics.steals
+        self._m_rebalances = metrics.rebalances
+
+    def _held_view(self, shard: int) -> Callable[[], float]:
+        return lambda: 1.0 if shard in self._owned else 0.0
+
+    # ------------------------------------------------------------------
+    def owned_shards(self) -> frozenset[int]:
+        return self._owned
+
+    def quota_fraction(self) -> float:
+        """This replica's slice of the global AWS budget: the quota is
+        divided evenly per shard, and budget follows ownership."""
+        return len(self._owned) / self.config.shard_count
+
+    def shard_map(self) -> dict:
+        with self._lock:
+            observed = dict(self._observed)
+        return {
+            "ring": self.ring.version,
+            "version": self.map_version,
+            "identity": self.identity,
+            "owned": sorted(self._owned),
+            "holders": {str(s): observed[s] for s in sorted(observed)},
+            "live_shards": sum(1 for h in observed.values() if h),
+        }
+
+    # ------------------------------------------------------------------
+    def tick(self, client) -> bool:
+        """One membership round; returns True when the owned set
+        changed (the manager rebalances quota and re-enqueues adopted
+        keys on True)."""
+        owned = set(self._owned)
+        changed = False
+        # renew what we hold; a failed CAS means someone stole an
+        # expired lease out from under a paused/partitioned replica —
+        # drop the shard before anything else consults the filter
+        for shard in sorted(owned):
+            acquired, holder = self._electors[shard].try_acquire_or_renew(client)
+            if acquired:
+                self._observe(shard, self.identity)
+            else:
+                owned.discard(shard)
+                self._publish(owned)
+                changed = True
+                self._electors[shard].set_leading(False)
+                self._observe(shard, holder or None)
+                klog.warningf(
+                    "shard %d lease lost to %s (identity %s)",
+                    shard, holder or "<unheld>", self.identity,
+                )
+        # claim at most one new shard per tick while below capacity;
+        # try_acquire_or_renew refuses fresh leases, so only unheld or
+        # expired ones are ever taken
+        if len(owned) < self.config.max_shards:
+            for shard in range(self.config.shard_count):
+                if shard in owned:
+                    continue
+                elector = self._electors[shard]
+                previous = elector.observed_holder()
+                acquired, holder = elector.try_acquire_or_renew(client)
+                if acquired:
+                    owned.add(shard)
+                    self._publish(owned)
+                    changed = True
+                    elector.set_leading(True)
+                    self._observe(shard, self.identity)
+                    if previous and previous != self.identity:
+                        self._m_steals.inc()
+                        klog.infof(
+                            "shard %d lease stolen from expired holder %s",
+                            shard, previous,
+                        )
+                    else:
+                        klog.infof("shard %d lease acquired", shard)
+                    break
+                self._observe(shard, holder or None)
+        else:
+            # at capacity: keep the observed map fresh with read-only
+            # probes so /healthz and the map-version gauge stay honest
+            for shard in range(self.config.shard_count):
+                if shard not in owned:
+                    self._observe(shard, self._peek_holder(client, shard))
+        if changed:
+            self._m_rebalances.inc()
+            if self.on_change is not None:
+                self.on_change(self)
+        return changed
+
+    def _peek_holder(self, client, shard: int) -> Optional[str]:
+        try:
+            lease = client.get(
+                "Lease", self.config.namespace,
+                f"{self.config.lease_prefix}-{shard}",
+            )
+            return lease.spec.holder_identity or None
+        except Exception:
+            return None
+
+    def _publish(self, owned: set[int]) -> None:
+        self._owned = frozenset(owned)
+
+    def _observe(self, shard: int, holder: Optional[str]) -> None:
+        with self._lock:
+            if self._observed.get(shard) != holder:
+                self._observed[shard] = holder
+                self.map_version += 1
+
+    # ------------------------------------------------------------------
+    def run(self, client, stop: threading.Event) -> None:
+        """The threaded loop (one immediate tick, then every
+        retry_period); the sim harness schedules ``tick`` itself."""
+        klog.infof(
+            "shard membership: identity %s contending for %d shards "
+            "(capacity %d)",
+            self.identity, self.config.shard_count, self.config.max_shards,
+        )
+        while not stop.is_set():
+            try:
+                self.tick(client)
+            except Exception as err:  # a bad tick must not kill the loop
+                klog.errorf("shard membership tick failed: %s", err)
+            stop.wait(self.config.lease.retry_period)
+        self.release_all(client)
+
+    def release_all(self, client) -> None:
+        """Clean shutdown: drop every shard locally FIRST, then release
+        the leases so successors claim them without waiting out the
+        lease duration."""
+        owned = sorted(self._owned)
+        self._publish(set())
+        for shard in owned:
+            elector = self._electors[shard]
+            elector.set_leading(False)
+            elector.release(client)
+        if owned and self.on_change is not None:
+            self.on_change(self)

@@ -75,6 +75,7 @@ from ..leaderelection import LeaderElection, LeaderElectionConfig
 from ..manager import ControllerConfig, Manager
 from ..reconcile.pending import PendingSettleTable
 from ..reconcile.reconcile import process_next_work_item
+from ..sharding import ShardingConfig
 from . import runtime
 
 # a pump round that never quiesces within this many worker steps is a
@@ -91,6 +92,13 @@ class SimHarnessConfig:
 
     cluster_name: str = "default"
     replicas: int = 1
+    # horizontal sharding (ISSUE 8): shard_count > 1 switches the
+    # harness into multi-replica mode — ``replicas`` concurrently-LIVE
+    # stacks, each with its own process-world (settle table, batcher,
+    # caches, health tracker) and its own shard membership over the
+    # shared Lease objects, instead of one active leader
+    shard_count: int = 1
+    shards_per_replica: int = 0
     resync_period: float = 3600.0
     settle_poll_interval: float = 1.0
     drift_tick_period: float = 0.0  # 0 = off
@@ -129,6 +137,95 @@ class SimHarnessConfig:
     settle_describes: int = 2
 
 
+class _World:
+    """One process's shared-memory state: the API health plane, the
+    pending-settle table, the Route53 change batcher, the read-plane
+    caches and the per-region LB coalescers.  Single-leader mode keeps
+    ONE world across leader generations (that state is process-level
+    there); sharded mode builds one world PER replica, so concurrently
+    live replicas can only communicate through the cluster and AWS —
+    never through shared caches, which would be cross-process
+    telepathy."""
+
+    def __init__(self, harness: "SimHarness"):
+        config = harness.config
+        scheduler = harness.scheduler
+        self._harness = harness
+        self.health = (
+            HealthTracker(
+                config=config.health,
+                clock=scheduler.monotonic,
+                sleep=scheduler.clock.sleep,
+            )
+            if config.health is not None
+            else None
+        )
+        self.settle_table = PendingSettleTable(clock=scheduler.monotonic)
+        self.batcher = (
+            ChangeBatcher(
+                max_changes=config.r53_batch_max,
+                linger=config.r53_batch_linger,
+                clock=scheduler.monotonic,
+            )
+            if config.r53_batch_linger > 0
+            else None
+        )
+        self.discovery = DiscoveryCache(
+            ttl=config.discovery_ttl,
+            tags_ttl=config.discovery_tags_ttl or None,
+            degraded=(
+                (lambda: self.health.is_open("globalaccelerator"))
+                if self.health is not None
+                else None
+            ),
+        )
+        self.zones = HostedZoneCache(ttl=config.zone_ttl)
+        self.topology = AcceleratorTopologyCache(
+            verify_ttl=config.read_plane_ttl, full_ttl=config.topology_full_ttl
+        )
+        self.records = RecordSetCache(
+            ttl=config.read_plane_ttl,
+            degraded=(
+                (lambda: self.health.is_open("route53"))
+                if self.health is not None
+                else None
+            ),
+        )
+        self.lb_coalescers: dict[str, LoadBalancerCoalescer] = {}
+
+    def cloud_factory(self, region: str) -> AWSDriver:
+        harness = self._harness
+        if self.health is not None:
+            ga = self.health.guard(harness.aws, "globalaccelerator", GA_OPS)
+            elbv2 = self.health.guard(harness.aws, f"elbv2[{region}]", ELBV2_OPS)
+            route53 = self.health.guard(harness.aws, "route53", ROUTE53_OPS)
+        else:
+            ga = elbv2 = route53 = harness.aws
+        coalescer = self.lb_coalescers.get(region)
+        if coalescer is None:
+            coalescer = self.lb_coalescers[region] = LoadBalancerCoalescer(
+                ttl=harness.config.read_plane_ttl, batch_window=0.0
+            )
+        return AWSDriver(
+            ga,
+            elbv2,
+            route53,
+            poll_interval=harness.config.poll_interval,
+            poll_timeout=harness.config.poll_timeout,
+            sleep=harness.scheduler.clock.sleep,
+            lb_not_active_retry=harness.config.lb_not_active_retry,
+            accelerator_missing_retry=harness.config.accelerator_missing_retry,
+            discovery_cache=self.discovery,
+            zone_cache=self.zones,
+            topology_cache=self.topology,
+            record_cache=self.records,
+            lb_coalescer=coalescer,
+            settle_table=self.settle_table,
+            change_batcher=self.batcher,
+            stage_requeue=harness.config.stage_requeue,
+        )
+
+
 class _WorkerEntry:
     """One queue's cooperative worker: the controller's own
     ``worker_specs()`` entry, circuit-wrapped exactly like
@@ -155,11 +252,22 @@ class _Stack:
     """One controller-process generation: a Manager + informers +
     worker entries, alive while its replica leads."""
 
-    def __init__(self, harness: "SimHarness", identity: str):
+    def __init__(
+        self,
+        harness: "SimHarness",
+        identity: str,
+        world: Optional[_World] = None,
+        controller_config: Optional[ControllerConfig] = None,
+    ):
         self.identity = identity
-        config = harness.controller_config
+        self.world = world if world is not None else harness.world
+        config = (
+            controller_config
+            if controller_config is not None
+            else harness.controller_config
+        )
         self.manager = Manager(
-            resync_period=harness.config.resync_period, health=harness.health
+            resync_period=harness.config.resync_period, health=self.world.health
         )
         self.informer_factory = SharedInformerFactory(
             harness.cluster,
@@ -167,9 +275,9 @@ class _Stack:
             clock=harness.scheduler.monotonic,
         )
         self.manager.build(
-            harness.cluster, config, harness.cloud_factory, self.informer_factory
+            harness.cluster, config, self.world.cloud_factory, self.informer_factory
         )
-        self.manager.settle_table = harness.settle_table
+        self.manager.settle_table = self.world.settle_table
         # initial list+sync, then per-informer watch cursors
         self.cursors: dict = {}
         for informer in self.informer_factory.informers():
@@ -275,6 +383,78 @@ class _SimElector:
         self.elector._release(self.harness.cluster)
 
 
+class _ShardReplica:
+    """One concurrently-live sharded controller replica (ISSUE 8): its
+    own process-world (settle table, batcher, caches, health tracker),
+    its own Manager — whose ``build()`` creates the shard membership
+    and filter — and a cooperative membership tick every retry_period.
+    The in-sim analog of a separate controller process: replicas talk
+    only through the shared cluster and AWS state."""
+
+    def __init__(self, harness: "SimHarness", identity: str):
+        self.harness = harness
+        self.identity = identity
+        self.dead = False
+        self.world = _World(harness)
+        config = harness.config
+        sharding = ShardingConfig(
+            shard_count=config.shard_count,
+            shards_per_replica=config.shards_per_replica,
+            lease=config.lease,
+            identity=identity,
+        )
+        self.controller_config = harness._make_controller_config(sharding)
+        self.stack = _Stack(
+            harness, identity, world=self.world,
+            controller_config=self.controller_config,
+        )
+        self.stack._sim_replica = self
+        # reshard adoptions drop this replica's world snapshots — the
+        # adopted chains were written by another replica's driver
+        self.stack.manager.on_reshard = self._invalidate_world
+        self.tick_event = harness.scheduler.every(
+            config.lease.retry_period,
+            self.shard_tick,
+            f"shard-tick:{identity}",
+            first_after=0.0,
+        )
+
+    def shard_tick(self) -> None:
+        if self.dead:
+            return
+        manager = self.stack.manager
+        try:
+            changed = manager.shard_tick(self.harness.cluster)
+        except SimulatedCrash as crash:
+            self.harness._handle_crash_replica(self, crash)
+            return
+        if changed:
+            self.harness.scheduler.record(
+                "shard", f"{self.identity}:{manager.shard_filter.token()}"
+            )
+        self.harness.check_exclusive_ownership()
+
+    def _invalidate_world(self) -> None:
+        world = self.world
+        world.discovery.invalidate()
+        world.zones.invalidate()
+        world.topology.invalidate_all()
+        world.records.invalidate_all()
+
+    def kill(self) -> None:
+        """Crash semantics: the stack vanishes, the shard leases stay
+        HELD until they expire under a survivor's observation."""
+        self.dead = True
+        self.tick_event.cancel()
+
+    def stop(self) -> None:
+        """Graceful shutdown: drop shards locally, then release the
+        leases for immediate takeover."""
+        self.dead = True
+        self.tick_event.cancel()
+        self.stack.manager.shard_membership.release_all(self.harness.cluster)
+
+
 class SimHarness:
     """Context manager owning one simulated world.  Use::
 
@@ -298,6 +478,9 @@ class SimHarness:
         self._stack: Optional[_Stack] = None
         self._electors: list[_SimElector] = []
         self._replica_serial = 0
+        # sharded multi-replica mode (ISSUE 8)
+        self._sharded = self.config.shard_count > 1
+        self._replicas: list["_ShardReplica"] = []
         self._queue_wake = None
         self._pumping = False
         self.generations = 0  # stacks built (leadership acquisitions)
@@ -346,50 +529,51 @@ class SimHarness:
             self.aws.install_fault_plan(FaultPlan(exempt_creator=False))
         self.fault_plan = self.aws.fault_plan
 
-        self.health = (
-            HealthTracker(
-                config=config.health,
-                clock=self.scheduler.monotonic,
-                sleep=self.scheduler.clock.sleep,
-            )
-            if config.health is not None
-            else None
-        )
-        self.settle_table = PendingSettleTable(clock=self.scheduler.monotonic)
-        self.batcher = (
-            ChangeBatcher(
-                max_changes=config.r53_batch_max,
-                linger=config.r53_batch_linger,
-                clock=self.scheduler.monotonic,
-            )
-            if config.r53_batch_linger > 0
-            else None
-        )
-        # shared read-plane caches (seam-resolved clocks)
-        self._discovery = DiscoveryCache(
-            ttl=config.discovery_ttl,
-            tags_ttl=config.discovery_tags_ttl or None,
-            degraded=(
-                (lambda: self.health.is_open("globalaccelerator"))
-                if self.health is not None
-                else None
-            ),
-        )
-        self._zones = HostedZoneCache(ttl=config.zone_ttl)
-        self._topology = AcceleratorTopologyCache(
-            verify_ttl=config.read_plane_ttl, full_ttl=config.topology_full_ttl
-        )
-        self._records = RecordSetCache(
-            ttl=config.read_plane_ttl,
-            degraded=(
-                (lambda: self.health.is_open("route53"))
-                if self.health is not None
-                else None
-            ),
-        )
-        self._lb_coalescers: dict[str, LoadBalancerCoalescer] = {}
+        if self._sharded:
+            # every replica gets its OWN process-world when it is
+            # built (add_shard_replica below); the harness-level
+            # aliases stay None so nothing accidentally shares state
+            self.world = None
+            self.health = None
+            self.settle_table = None
+            self.batcher = None
+            self.controller_config = self._make_controller_config()
+        else:
+            self.world = _World(self)
+            self.health = self.world.health
+            self.settle_table = self.world.settle_table
+            self.batcher = self.world.batcher
+            self.controller_config = self._make_controller_config()
 
-        self.controller_config = ControllerConfig(
+        # recurring plumbing ticks (priority 1: after same-instant
+        # scenario actors, before nothing in particular — stable order)
+        self.scheduler.every(
+            config.settle_poll_interval, self._settle_tick, "settle-poll", priority=1
+        )
+        if config.drift_tick_period > 0:
+            self.scheduler.every(
+                config.drift_tick_period, self._drift_tick, "drift-tick", priority=1
+            )
+        if config.gc_sweep_period > 0:
+            self.scheduler.every(
+                config.gc_sweep_period, self._gc_tick, "gc-sweep", priority=1
+            )
+        self.scheduler.every(
+            config.resync_period, self._resync_tick, "informer-resync", priority=1
+        )
+        if self._sharded:
+            for _ in range(config.replicas):
+                self.add_shard_replica()
+        else:
+            for _ in range(config.replicas):
+                self._add_replica()
+        return self
+
+    def _make_controller_config(
+        self, sharding: Optional[ShardingConfig] = None
+    ) -> ControllerConfig:
+        config = self.config
+        return ControllerConfig(
             global_accelerator=GlobalAcceleratorConfig(
                 cluster_name=config.cluster_name,
                 queue_qps=config.queue_qps,
@@ -417,27 +601,8 @@ class SimHarness:
                 cluster_name=config.cluster_name,
             ),
             settle_poll_interval=config.settle_poll_interval,
+            sharding=sharding if sharding is not None else ShardingConfig(),
         )
-
-        # recurring plumbing ticks (priority 1: after same-instant
-        # scenario actors, before nothing in particular — stable order)
-        self.scheduler.every(
-            config.settle_poll_interval, self._settle_tick, "settle-poll", priority=1
-        )
-        if config.drift_tick_period > 0:
-            self.scheduler.every(
-                config.drift_tick_period, self._drift_tick, "drift-tick", priority=1
-            )
-        if config.gc_sweep_period > 0:
-            self.scheduler.every(
-                config.gc_sweep_period, self._gc_tick, "gc-sweep", priority=1
-            )
-        self.scheduler.every(
-            config.resync_period, self._resync_tick, "informer-resync", priority=1
-        )
-        for _ in range(config.replicas):
-            self._add_replica()
-        return self
 
     def __exit__(self, *exc) -> None:
         from .. import clockseam
@@ -449,35 +614,84 @@ class SimHarness:
     # cloud factory (the per-region driver production would build)
     # ------------------------------------------------------------------
     def cloud_factory(self, region: str) -> AWSDriver:
-        if self.health is not None:
-            ga = self.health.guard(self.aws, "globalaccelerator", GA_OPS)
-            elbv2 = self.health.guard(self.aws, f"elbv2[{region}]", ELBV2_OPS)
-            route53 = self.health.guard(self.aws, "route53", ROUTE53_OPS)
-        else:
-            ga = elbv2 = route53 = self.aws
-        coalescer = self._lb_coalescers.get(region)
-        if coalescer is None:
-            coalescer = self._lb_coalescers[region] = LoadBalancerCoalescer(
-                ttl=self.config.read_plane_ttl, batch_window=0.0
-            )
-        return AWSDriver(
-            ga,
-            elbv2,
-            route53,
-            poll_interval=self.config.poll_interval,
-            poll_timeout=self.config.poll_timeout,
-            sleep=self.scheduler.clock.sleep,
-            lb_not_active_retry=self.config.lb_not_active_retry,
-            accelerator_missing_retry=self.config.accelerator_missing_retry,
-            discovery_cache=self._discovery,
-            zone_cache=self._zones,
-            topology_cache=self._topology,
-            record_cache=self._records,
-            lb_coalescer=coalescer,
-            settle_table=self.settle_table,
-            change_batcher=self.batcher,
-            stage_requeue=self.config.stage_requeue,
-        )
+        """Single-leader mode's driver factory (the process world's);
+        sharded replicas each build drivers from their OWN world."""
+        return self.world.cloud_factory(region)
+
+    # ------------------------------------------------------------------
+    # sharded multi-replica mode (ISSUE 8)
+    # ------------------------------------------------------------------
+    def add_shard_replica(self) -> "_ShardReplica":
+        """Add one concurrently-live sharded replica (its own world,
+        manager, membership and informer cursors)."""
+        assert self._sharded, "add_shard_replica needs shard_count > 1"
+        self._replica_serial += 1
+        replica = _ShardReplica(self, f"shard-replica-{self._replica_serial}")
+        self._replicas.append(replica)
+        self.generations += 1
+        if self.on_stack_built is not None:
+            self.on_stack_built(self, replica.stack)
+        return replica
+
+    def live_replicas(self) -> list["_ShardReplica"]:
+        return [replica for replica in self._replicas if not replica.dead]
+
+    def kill_shard_replica(
+        self, identity: Optional[str] = None, replace: bool = False
+    ) -> str:
+        """Hard-kill a sharded replica (default: the first live one):
+        its stack and world vanish, its shard leases stay HELD — a
+        survivor with spare capacity steals them one lease_duration
+        after the last renewal it observed, then adopts the orphaned
+        keyspace via the reshard resync."""
+        for replica in self._replicas:
+            if replica.dead:
+                continue
+            if identity is None or replica.identity == identity:
+                self.scheduler.record("shard", f"killed:{replica.identity}")
+                replica.kill()
+                if replace:
+                    self.add_shard_replica()
+                return replica.identity
+        raise RuntimeError(f"no live shard replica matching {identity!r}")
+
+    def stop_shard_replica(self, identity: Optional[str] = None) -> str:
+        """Gracefully stop a sharded replica: shards are dropped
+        locally first, then the leases released, so successors claim
+        them without waiting out the lease duration."""
+        for replica in self._replicas:
+            if replica.dead:
+                continue
+            if identity is None or replica.identity == identity:
+                self.scheduler.record("shard", f"released:{replica.identity}")
+                replica.stop()
+                return replica.identity
+        raise RuntimeError(f"no live shard replica matching {identity!r}")
+
+    def shard_ownership(self) -> dict[str, frozenset[int]]:
+        """Live replicas' owned-shard sets — the exclusive-ownership
+        oracle's subject."""
+        return {
+            replica.identity: replica.stack.manager.shard_membership.owned_shards()
+            for replica in self.live_replicas()
+        }
+
+    def check_exclusive_ownership(self) -> None:
+        """The no-key-owned-by-two-shards oracle, continuous form:
+        called after every membership tick; any overlap between two
+        LIVE replicas' owned sets is appended to ``violations``.
+        (A dead replica's stale leases are unowned keyspace, not an
+        overlap — nobody enqueues for them until a survivor steals.)"""
+        ownership = sorted(self.shard_ownership().items())
+        for i, (id_a, owned_a) in enumerate(ownership):
+            for id_b, owned_b in ownership[i + 1:]:
+                overlap = owned_a & owned_b
+                if overlap:
+                    self.violations.append(
+                        f"exclusive-ownership: shards {sorted(overlap)} owned "
+                        f"by BOTH {id_a} and {id_b} at "
+                        f"t={self.scheduler.monotonic():.1f}"
+                    )
 
     # ------------------------------------------------------------------
     # leadership
@@ -533,6 +747,19 @@ class SimHarness:
         if self._stack is not None:
             self.kill_leader()
 
+    def _handle_crash_replica(
+        self, replica: "_ShardReplica", crash: SimulatedCrash
+    ) -> None:
+        """Sharded-mode crash: the replica whose worker/tick hit the
+        crash boundary dies (leases stay held); a replacement contender
+        joins so the pool size is preserved, exactly like
+        ``kill_leader``."""
+        klog.warningf("sim: %s — killing %s", crash, replica.identity)
+        self.scheduler.record("crash", f"{crash.op}:{crash.when}")
+        self.scheduler.record("shard", f"crashed:{replica.identity}")
+        replica.kill()
+        self.add_shard_replica()
+
     def demote_leader(self) -> None:
         """Gracefully stop the leading replica (lease released)."""
         for elector in self._electors:
@@ -548,6 +775,14 @@ class SimHarness:
     # recurring plumbing ticks
     # ------------------------------------------------------------------
     def _settle_tick(self) -> None:
+        if self._sharded:
+            for replica in self.live_replicas():
+                if replica.world.settle_table.depth():
+                    try:
+                        replica.world.settle_table.poll_once()
+                    except SimulatedCrash as crash:
+                        self._handle_crash_replica(replica, crash)
+            return
         if self._stack is not None and self.settle_table.depth():
             try:
                 self.settle_table.poll_once()
@@ -555,6 +790,13 @@ class SimHarness:
                 self._handle_crash(crash)
 
     def _drift_tick(self) -> None:
+        if self._sharded:
+            for replica in self.live_replicas():
+                try:
+                    replica.stack.manager.drift_tick()
+                except SimulatedCrash as crash:
+                    self._handle_crash_replica(replica, crash)
+            return
         if self._stack is not None:
             try:
                 self._stack.manager.drift_tick()
@@ -562,26 +804,49 @@ class SimHarness:
                 self._handle_crash(crash)
 
     def _gc_tick(self) -> None:
-        if self._stack is None or self._stack.manager.gc is None:
-            return
-        if self.on_gc_sweep_begin is not None:
-            self.on_gc_sweep_begin(self)
-        try:
-            report = self._stack.manager.gc_sweep()
-        except SimulatedCrash as crash:
-            self._handle_crash(crash)
-            return
-        if self.on_gc_sweep is not None:
-            self.on_gc_sweep(self, report)
+        for stack in self.live_stacks():
+            if stack.manager.gc is None:
+                continue
+            if self.on_gc_sweep_begin is not None:
+                self.on_gc_sweep_begin(self)
+            try:
+                report = stack.manager.gc_sweep()
+            except SimulatedCrash as crash:
+                if self._sharded:
+                    self._handle_crash_replica(stack._sim_replica, crash)
+                    continue
+                self._handle_crash(crash)
+                return
+            if self.on_gc_sweep is not None:
+                self.on_gc_sweep(self, report)
 
     def _resync_tick(self) -> None:
-        if self._stack is not None:
-            self._stack.resync(self)
+        for stack in self.live_stacks():
+            stack.resync(self)
 
     # ------------------------------------------------------------------
     # the cooperative executor
     # ------------------------------------------------------------------
-    def _step_worker(self, entry: _WorkerEntry) -> None:
+    def live_stacks(self) -> list[_Stack]:
+        """Every live stack, in deterministic construction order: the
+        leader's (single mode) or one per live sharded replica."""
+        if self._sharded:
+            return [replica.stack for replica in self.live_replicas()]
+        return [self._stack] if self._stack is not None else []
+
+    def settle_tables(self) -> list:
+        """Every live pending-settle table (one per process-world)."""
+        if self._sharded:
+            return [replica.world.settle_table for replica in self.live_replicas()]
+        return [self.settle_table] if self.settle_table is not None else []
+
+    def _stack_alive(self, stack: _Stack) -> bool:
+        if self._sharded:
+            replica = getattr(stack, "_sim_replica", None)
+            return replica is not None and not replica.dead
+        return self._stack is stack
+
+    def _step_worker(self, stack: _Stack, entry: _WorkerEntry) -> None:
         key = entry.queue.peek()
         self.scheduler.record("work", f"{entry.name}:{key}")
         thread = threading.current_thread()
@@ -599,38 +864,42 @@ class SimHarness:
                 reconcile_deadline=entry.reconcile_deadline,
             )
         except SimulatedCrash as crash:
-            # the in-sim analog of os._exit(137): the leading
-            # "process" dies at this exact API boundary — its whole
-            # stack vanishes, the lease stays held, recovery is the
-            # standby's takeover + level-triggered resync
-            self._handle_crash(crash)
+            # the in-sim analog of os._exit(137): the "process" whose
+            # worker hit this API boundary dies — its whole stack
+            # vanishes, its lease(s) stay held, recovery is takeover +
+            # level-triggered resync
+            if self._sharded:
+                self._handle_crash_replica(stack._sim_replica, crash)
+            else:
+                self._handle_crash(crash)
         finally:
             thread.name = original
 
     def _pump(self) -> None:
         """Drain everything runnable at the current virtual instant:
         informer deltas, matured queue delays, and every ready work
-        item — one item per queue per round, round-robin, until
-        quiescent.  This is the cooperative thread-step executor; its
-        iteration order (informers in construction order, then queues
-        in construction order) IS the deterministic ready-queue
-        order."""
+        item — one item per queue per round, round-robin over every
+        live stack, until quiescent.  This is the cooperative
+        thread-step executor; its iteration order (stacks in
+        construction order; informers then queues in construction
+        order within each) IS the deterministic ready-queue order."""
         if self._pumping:
             return  # re-entrancy guard (an actor stepping inside pump)
         self._pumping = True
         try:
             steps = 0
             while True:
-                stack = self._stack
                 progress = False
-                if stack is not None:
+                for stack in self.live_stacks():
+                    if not self._stack_alive(stack):
+                        continue  # crashed earlier in this round
                     progress |= stack.pump_informers(self)
                     for entry in stack.workers:
-                        if self._stack is not stack:
-                            break  # a crash killed this generation
+                        if not self._stack_alive(stack):
+                            break  # a crash killed this stack
                         entry.queue.pop_due_delays()
                         if len(entry.queue):
-                            self._step_worker(entry)
+                            self._step_worker(stack, entry)
                             progress = True
                             steps += 1
                 if not progress:
@@ -638,7 +907,8 @@ class SimHarness:
                 if steps > PUMP_STEP_LIMIT:
                     depths = {
                         e.name: len(e.queue)
-                        for e in (stack.workers if stack else [])
+                        for s in self.live_stacks()
+                        for e in s.workers
                     }
                     raise RuntimeError(
                         f"sim pump livelock: {steps} worker steps without "
@@ -648,11 +918,10 @@ class SimHarness:
             self._pumping = False
 
     def _schedule_queue_wake(self) -> None:
-        if self._stack is None:
-            return
         deadlines = [
             deadline
-            for entry in self._stack.workers
+            for stack in self.live_stacks()
+            for entry in stack.workers
             if (deadline := entry.queue.next_delay_deadline()) is not None
         ]
         if not deadlines:
@@ -711,13 +980,16 @@ class SimHarness:
         return not self._busy()
 
     def _busy(self) -> bool:
-        if self._stack is None:
+        stacks = self.live_stacks()
+        if not stacks:
             return False
-        if self.settle_table.depth():
-            return True
-        for entry in self._stack.workers:
-            if len(entry.queue) or entry.queue.next_delay_deadline() is not None:
+        for table in self.settle_tables():
+            if table.depth():
                 return True
+        for stack in stacks:
+            for entry in stack.workers:
+                if len(entry.queue) or entry.queue.next_delay_deadline() is not None:
+                    return True
         return False
 
     # ------------------------------------------------------------------
@@ -733,12 +1005,21 @@ class SimHarness:
         return self.scheduler.trace_hash()
 
     def stats(self) -> dict:
-        return {
+        stats = {
             "virtual_time": round(self.scheduler.monotonic(), 3),
             "events": self.scheduler.events_dispatched,
             "aws_calls": len(self.aws.calls),
             "generations": self.generations,
             "leader": self.leader(),
-            "settle": self.settle_table.stats(),
-            "batcher": self.batcher.stats() if self.batcher else None,
         }
+        if self._sharded:
+            stats["replicas"] = [r.identity for r in self.live_replicas()]
+            stats["ownership"] = {
+                identity: sorted(owned)
+                for identity, owned in self.shard_ownership().items()
+            }
+            stats["settle"] = [table.stats() for table in self.settle_tables()]
+        else:
+            stats["settle"] = self.settle_table.stats()
+            stats["batcher"] = self.batcher.stats() if self.batcher else None
+        return stats

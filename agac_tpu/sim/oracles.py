@@ -156,37 +156,71 @@ def check_record_atomicity(harness, cluster_name: str = "default") -> list[str]:
 
 
 def check_settle_drained(harness) -> list[str]:
-    depth = harness.settle_table.depth()
-    if depth:
-        return [
-            "pending-settle: "
-            f"{depth} entries still parked at quiescence "
-            f"({harness.settle_table.depth_by_group()})"
-        ]
-    return []
+    """Nothing parked at quiescence, across every live process-world
+    (one settle table per sharded replica)."""
+    violations = []
+    for table in harness.settle_tables():
+        depth = table.depth()
+        if depth:
+            violations.append(
+                "pending-settle: "
+                f"{depth} entries still parked at quiescence "
+                f"({table.depth_by_group()})"
+            )
+    return violations
 
 
 def check_no_residue(harness) -> list[str]:
-    """Every workqueue fully drained (ready AND delayed)."""
-    if harness._stack is None:
-        return []
+    """Every workqueue of every live stack fully drained (ready AND
+    delayed)."""
     violations = []
-    for entry in harness._stack.workers:
-        if len(entry.queue):
-            violations.append(f"residue: {entry.name} has ready items")
-        if entry.queue.next_delay_deadline() is not None:
-            violations.append(f"residue: {entry.name} has delayed items parked")
+    for stack in harness.live_stacks():
+        for entry in stack.workers:
+            if len(entry.queue):
+                violations.append(
+                    f"residue: {stack.identity}/{entry.name} has ready items"
+                )
+            if entry.queue.next_delay_deadline() is not None:
+                violations.append(
+                    f"residue: {stack.identity}/{entry.name} has delayed items parked"
+                )
+    return violations
+
+
+def check_exclusive_shard_ownership(harness) -> list[str]:
+    """The no-key-owned-by-two-shards oracle (ISSUE 8), final-state
+    form: live replicas' owned-shard sets are pairwise disjoint AND
+    every violation the continuous per-tick check accumulated is
+    surfaced.  (Key exclusivity follows: the ring is deterministic and
+    shared, so disjoint shard sets ⇒ disjoint key sets.)"""
+    violations = [
+        v for v in harness.violations if v.startswith("exclusive-ownership")
+    ]
+    ownership = sorted(harness.shard_ownership().items())
+    for i, (id_a, owned_a) in enumerate(ownership):
+        for id_b, owned_b in ownership[i + 1:]:
+            overlap = owned_a & owned_b
+            if overlap:
+                entry = (
+                    f"exclusive-ownership: shards {sorted(overlap)} owned by "
+                    f"BOTH {id_a} and {id_b} at quiescence"
+                )
+                if entry not in violations:
+                    violations.append(entry)
     return violations
 
 
 def standard_oracles(harness, cluster_name: str = "default") -> list[str]:
     """The full final-state battery."""
-    return (
+    violations = (
         check_convergence(harness)
         + check_record_atomicity(harness, cluster_name)
         + check_settle_drained(harness)
         + check_no_residue(harness)
     )
+    if getattr(harness, "_sharded", False):
+        violations += check_exclusive_shard_ownership(harness)
+    return violations
 
 
 # ---------------------------------------------------------------------------

@@ -1213,6 +1213,305 @@ def run_drift_tick(n: int, workers: int) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# sharding phase (ISSUE 8): 2-shard REAL subprocesses over one durable
+# fake account
+# ---------------------------------------------------------------------------
+
+# fleet size of the multi-process phase; the CI smoke test shrinks it
+# (speedup is only asserted at >= SHARD_GATE_MIN_N — tiny fleets are
+# dominated by process startup, not throughput)
+SHARD_N = int(os.environ.get("AGAC_BENCH_SHARD_N", "150"))
+SHARD_WORKERS = int(os.environ.get("AGAC_BENCH_SHARD_WORKERS", "8"))
+# per-call wire latency shaping the subprocesses (AGAC_FAKE_LATENCY):
+# throughput is then bound by each process's worker pool x latency —
+# the per-process capacity model sharding divides.  0.15 s ~ the
+# real-world GA mutate p50 band.
+SHARD_LATENCY = float(os.environ.get("AGAC_BENCH_SHARD_LATENCY", "0.15"))
+# the global per-service AWS budget (calls/s): each replica's AIMD
+# ceiling is budget x owned/shard_count, so the fleet aggregate can
+# never exceed it — asserted from measured call rates below
+SHARD_BUDGET_QPS = float(os.environ.get("AGAC_BENCH_SHARD_BUDGET", "400"))
+SHARD_MIN_SPEEDUP = 1.7
+SHARD_GATE_MIN_N = 100
+
+SHARD_LB_NAME = "shardlb"
+SHARD_LB_HOSTNAME = "shardlb-0123456789abcdef.elb.us-west-2.amazonaws.com"
+
+
+def _shard_service(i: int) -> Service:
+    svc = Service(
+        metadata=ObjectMeta(
+            name=f"shard{i:04d}",
+            namespace="default",
+            annotations={
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+            },
+        ),
+        spec=ServiceSpec(
+            type="LoadBalancer",
+            ports=[ServicePort(name="http", port=80, protocol="TCP")],
+        ),
+    )
+    svc.status.load_balancer.ingress.append(
+        LoadBalancerIngress(hostname=SHARD_LB_HOSTNAME)
+    )
+    return svc
+
+
+def _scrape_shard_process(port: int) -> dict:
+    """One subprocess's telemetry: per-service AWS call totals off
+    /metrics, per-service AIMD ceilings off /readyz, and the shard
+    assignment off /healthz — the same wires an operator scrapes."""
+    calls: dict[str, float] = {}
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ) as response:
+        for line in response.read().decode().splitlines():
+            if line.startswith("agac_aws_api_calls_total{"):
+                labels, value = line.rsplit(" ", 1)
+                service = labels.split('service="')[1].split('"')[0]
+                # elbv2[region] folds into elbv2: the budget is per
+                # service family here
+                service = service.split("[", 1)[0]
+                calls[service] = calls.get(service, 0.0) + float(value)
+    ceilings: dict[str, float] = {}
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/readyz", timeout=5
+        ) as response:
+            ready = json.loads(response.read())
+    except urllib.error.HTTPError as err:  # 503 while a circuit is open
+        ready = json.loads(err.read())
+    for service, snap in ready.get("services", {}).items():
+        if "aimd_ceiling" in snap:
+            family = service.split("[", 1)[0]
+            ceilings[family] = ceilings.get(family, 0.0) + snap["aimd_ceiling"]
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=5
+    ) as response:
+        sharding = json.loads(response.read())["sharding"]
+    return {"calls": calls, "ceilings": ceilings, "sharding": sharding}
+
+
+def _run_shard_fleet(shard_count: int, replicas: int, n: int) -> dict:
+    """Converge ``n`` Services through ``replicas`` REAL controller
+    subprocesses sharing one durable fake account (flock-arbitrated
+    state file) and one embedded apiserver; returns throughput and
+    per-replica telemetry."""
+    import socket
+    import subprocess
+    import tempfile
+
+    import yaml
+
+    from agac_tpu.cloudprovider.aws.fake_backend import FileBackedFakeAWSBackend
+    from agac_tpu.cluster.rest import RestClusterClient
+    from agac_tpu.cluster.testserver import TestApiServer
+
+    tmp = tempfile.mkdtemp(prefix="agac-shard-bench-")
+    state_path = os.path.join(tmp, "aws-state.json")
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def free_port() -> int:
+        with socket.socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            return sock.getsockname()[1]
+
+    with TestApiServer() as server:
+        kubeconfig_path = os.path.join(tmp, "kubeconfig")
+        with open(kubeconfig_path, "w") as f:
+            yaml.safe_dump(
+                {
+                    "current-context": "bench",
+                    "contexts": [
+                        {"name": "bench", "context": {"cluster": "c", "user": "u"}}
+                    ],
+                    "clusters": [{"name": "c", "cluster": {"server": server.url}}],
+                    "users": [{"name": "u", "user": {}}],
+                },
+                f,
+            )
+        client = RestClusterClient(server.url)
+        env = dict(
+            os.environ,
+            AGAC_CLOUD="fake",
+            AGAC_FAKE_STATE=state_path,
+            AGAC_FAKE_LBS=f"{SHARD_LB_NAME}={SHARD_LB_HOSTNAME}",
+            AGAC_FAKE_LATENCY=str(SHARD_LATENCY),
+            AGAC_FAKE_QUOTA_ACCELERATORS=str(n + 20),
+            POD_NAMESPACE="kube-system",
+            AGAC_API_HEALTH_AIMD_QPS=str(SHARD_BUDGET_QPS),
+            # failover-grade lease timing (sub-5s takeover) that still
+            # tolerates GIL pauses of two busy processes on one core
+            AGAC_LEASE_DURATION="4",
+            AGAC_LEASE_RENEW_DEADLINE="2",
+            AGAC_LEASE_RETRY_PERIOD="0.3",
+            AGAC_ACCELERATOR_MISSING_RETRY="0.1",
+            AGAC_LB_NOT_ACTIVE_RETRY="0.1",
+            AGAC_POLL_INTERVAL="0.02",
+            AGAC_POLL_TIMEOUT="5",
+        )
+        ports = [free_port() for _ in range(replicas)]
+        processes = []
+        for port in ports:
+            argv = [
+                sys.executable, "-m", "agac_tpu", "controller",
+                "--kubeconfig", kubeconfig_path,
+                "-c", "bench-shard",
+                "-w", str(SHARD_WORKERS),
+                "--queue-qps", "1000", "--queue-burst", "1000",
+                "--health-port", str(port),
+                "--shard-count", str(shard_count),
+            ]
+            if shard_count > 1:
+                argv += ["--shards-per-replica", "1"]
+            else:
+                argv += ["--disable-leader-election"]
+            processes.append(
+                subprocess.Popen(
+                    argv, cwd=repo, env=env,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                )
+            )
+        try:
+            # every shard lease held before the clock starts (startup
+            # is measured by the process drills, not this phase)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    views = [_scrape_shard_process(port)["sharding"] for port in ports]
+                except Exception:
+                    time.sleep(0.2)
+                    continue
+                if shard_count == 1:
+                    break
+                held = set().union(
+                    *[set(view.get("owned", ())) for view in views if view.get("enabled")]
+                )
+                if held == set(range(shard_count)):
+                    break
+                time.sleep(0.2)
+
+            t0 = time.monotonic()
+            for i in range(n):
+                client.create("Service", _shard_service(i))
+            aws = FileBackedFakeAWSBackend(state_path)
+            while time.monotonic() - t0 < DEADLINE:
+                accelerators, listeners, groups = aws.chain_counts()
+                if accelerators == listeners == groups == n:
+                    break
+                time.sleep(0.3)
+            else:
+                raise SystemExit(
+                    f"sharding phase ({shard_count} shards): fleet never "
+                    f"converged ({aws.chain_counts()} of {n})"
+                )
+            elapsed = time.monotonic() - t0
+            per_replica = [_scrape_shard_process(port) for port in ports]
+        finally:
+            for process in processes:
+                process.terminate()
+            for process in processes:
+                try:
+                    process.wait(10)
+                except Exception:
+                    process.kill()
+    calls_by_service: dict[str, float] = {}
+    for replica in per_replica:
+        for service, count in replica["calls"].items():
+            calls_by_service[service] = calls_by_service.get(service, 0.0) + count
+    return {
+        "shard_count": shard_count,
+        "replicas": replicas,
+        "n_objects": n,
+        "elapsed_s": round(elapsed, 2),
+        "objects_per_sec": round(n / elapsed, 2),
+        "aws_calls_by_service": {k: int(v) for k, v in sorted(calls_by_service.items())},
+        "aggregate_calls_per_sec_by_service": {
+            service: round(count / elapsed, 2)
+            for service, count in sorted(calls_by_service.items())
+        },
+        "per_replica": [
+            {
+                "owned_shards": replica["sharding"].get("owned", []),
+                "quota_fraction": replica["sharding"].get("quota_fraction"),
+                "aimd_ceilings": replica["ceilings"],
+                "aws_calls": {k: int(v) for k, v in sorted(replica["calls"].items())},
+            }
+            for replica in per_replica
+        ],
+    }
+
+
+def run_sharding_phase() -> dict:
+    """The 2-shard multi-process phase: single-shard headline first,
+    then two concurrently-live sharded replicas over the same durable
+    account — asserting the quota-division invariant (aggregate call
+    rate and summed AIMD ceilings within the global budget) and, at
+    full scale, the >= 1.7x scale-out bar."""
+    _progress(
+        f"sharding: single-shard headline over {SHARD_N} services "
+        f"({SHARD_WORKERS} workers, {SHARD_LATENCY:g}s call latency)"
+    )
+    single = _run_shard_fleet(1, 1, SHARD_N)
+    _progress(
+        f"sharding: single {single['objects_per_sec']} objects/s in "
+        f"{single['elapsed_s']}s"
+    )
+    _progress("sharding: 2-shard fleet (2 live replicas, divided quota)")
+    sharded = _run_shard_fleet(2, 2, SHARD_N)
+    _progress(
+        f"sharding: 2-shard aggregate {sharded['objects_per_sec']} objects/s "
+        f"in {sharded['elapsed_s']}s"
+    )
+    speedup = round(
+        sharded["objects_per_sec"] / max(single["objects_per_sec"], 1e-9), 2
+    )
+    phase = {
+        "single": single,
+        "sharded": sharded,
+        "speedup": speedup,
+        "quota_budget_per_service_qps": SHARD_BUDGET_QPS,
+        "workers_per_replica": SHARD_WORKERS,
+        "call_latency_s": SHARD_LATENCY,
+        "note": (
+            "real controller subprocesses over one flock-arbitrated durable "
+            "fake account; per-process capacity = workers x call latency, "
+            "divided AIMD budget = global x owned/shard_count"
+        ),
+    }
+    # the quota-division contract: the fleet AGGREGATE never exceeds
+    # the global per-service budget — in measured call rates AND in the
+    # structural sum of the live replicas' AIMD ceilings
+    for run in (single, sharded):
+        for service, rate in run["aggregate_calls_per_sec_by_service"].items():
+            if rate > SHARD_BUDGET_QPS * 1.001:
+                raise SystemExit(
+                    f"sharding phase: aggregate {service} call rate "
+                    f"{rate}/s exceeds the global budget {SHARD_BUDGET_QPS}/s"
+                )
+    ceiling_sums: dict[str, float] = {}
+    for replica in sharded["per_replica"]:
+        for service, ceiling in replica["aimd_ceilings"].items():
+            ceiling_sums[service] = ceiling_sums.get(service, 0.0) + ceiling
+    for service, total in ceiling_sums.items():
+        if total > SHARD_BUDGET_QPS * 1.001:
+            raise SystemExit(
+                f"sharding phase: summed {service} AIMD ceilings {total}/s "
+                f"exceed the global budget {SHARD_BUDGET_QPS}/s — quota "
+                "division is broken"
+            )
+    if SHARD_N >= SHARD_GATE_MIN_N and speedup < SHARD_MIN_SPEEDUP:
+        raise SystemExit(
+            f"sharding phase: 2-shard aggregate is only {speedup}x the "
+            f"single-shard headline (bar: {SHARD_MIN_SPEEDUP}x) — see "
+            "bench_detail.json sharding block"
+        )
+    return phase
+
+
 def main():
     klog.init(verbosity=-1)
     import logging
@@ -1287,6 +1586,14 @@ def main():
     drift = run_drift_tick(DRIFT_N, workers=TUNED_WORKERS)
     drift["metrics_snapshot"] = scrape_metrics(metrics_port)
     _progress(f"drift tick: {drift['aws_calls_total']} AWS calls/tick")
+    # the horizontal sharding phase (ISSUE 8): real subprocesses, so it
+    # runs last — its processes must not share this process's registry
+    sharding = run_sharding_phase()
+    _progress(
+        f"sharding: speedup {sharding['speedup']}x "
+        f"({sharding['sharded']['objects_per_sec']} vs "
+        f"{sharding['single']['objects_per_sec']} objects/s)"
+    )
 
     steady = tuned.pop("steady_state")
     churn = tuned.pop("egb_churn")
@@ -1308,6 +1615,10 @@ def main():
         "pending_settle": pending_settle,
         "r53_batching": r53_batching,
         "drift_tick": drift,
+        # the 2-shard multi-process phase (ISSUE 8): single-shard
+        # headline vs two concurrently-live replicas, with quota
+        # division asserted
+        "sharding": sharding,
         "latency_model": {
             "scale": f"real-world seconds / {LATENCY_SCALE:g}; quotas x{LATENCY_SCALE:g}",
             "real_latency_s": REAL_LATENCY,
@@ -1351,6 +1662,11 @@ def main():
             "aws_calls": drift["aws_calls_total"],
             "derived_s_scaled": drift["derived_tick_seconds_scaled"],
             "derived_s_real": drift["derived_tick_seconds_real_quotas"],
+        },
+        # scale-out at a glance: 2-shard aggregate vs single-shard
+        "sharding": {
+            "speedup": sharding["speedup"],
+            "agg_objs_per_sec": sharding["sharded"]["objects_per_sec"],
         },
         "detail_file": os.path.basename(DETAIL_PATH),
     }

@@ -859,6 +859,115 @@ class TestUnseamedClock:
         )
 
 
+class TestCrossShardSweep:
+    """The sharding plane's enumeration-path gate (ISSUE 8): a GC
+    sweep phase or drift enumeration that forgets the shard filter
+    silently makes every replica work every key."""
+
+    def test_unfiltered_sweep_phase_fires_once(self):
+        v = only(
+            run(
+                """
+                class GarbageCollector:
+                    def _sweep_accelerators(self, cloud, report, budget):
+                        for accelerator, tags in cloud.list_cluster_owned_pairs("c"):
+                            report["candidates"] += 1
+                """,
+                path="agac_tpu/controllers/garbagecollector.py",
+            ),
+            "cross-shard-sweep",
+        )
+        assert "_sweep_accelerators" in v.message
+
+    def test_filtered_sweep_phase_is_clean(self):
+        assert (
+            run(
+                """
+                class GarbageCollector:
+                    def _sweep_accelerators(self, cloud, report, budget):
+                        for accelerator, owner in cloud.list_cluster_owned_pairs("c"):
+                            if not self._shards.owns(owner[1], owner[2]):
+                                continue
+                            report["candidates"] += 1
+                """,
+                path="agac_tpu/controllers/garbagecollector.py",
+            )
+            == []
+        )
+
+    def test_unfiltered_drift_sources_fire(self):
+        v = only(
+            run(
+                """
+                class Controller:
+                    def drift_resync_sources(self):
+                        return [(self.lister, lambda o: True, self.queue.add)]
+                """,
+                path="agac_tpu/controllers/somecontroller.py",
+            ),
+            "cross-shard-sweep",
+        )
+        assert "drift_resync_sources" in v.message
+
+    def test_shard_aware_drift_sources_are_clean(self):
+        assert (
+            run(
+                """
+                class Controller:
+                    def drift_resync_sources(self):
+                        owns = self._shards.owns_obj
+                        return [(self.lister, owns, self.queue.add)]
+                """,
+                path="agac_tpu/controllers/somecontroller.py",
+            )
+            == []
+        )
+
+    def test_unfiltered_manager_drift_tick_fires(self):
+        v = only(
+            run(
+                """
+                class Manager:
+                    def drift_tick(self):
+                        for name, controller in self.controllers.items():
+                            for lister, predicate, enqueue in controller.drift_resync_sources():
+                                for obj in lister.list():
+                                    enqueue(obj)
+                """,
+                path="agac_tpu/manager.py",
+            ),
+            "cross-shard-sweep",
+        )
+        assert "drift_tick" in v.message
+
+    def test_rule_is_scoped_to_manager_and_controllers(self):
+        # the same unfiltered shape outside the enumeration modules
+        # (e.g. a driver helper) is out of scope
+        assert (
+            run(
+                """
+                def drift_tick(self):
+                    for obj in self.lister.list():
+                        self.enqueue(obj)
+                """,
+                path="agac_tpu/cloudprovider/aws/driver.py",
+            )
+            == []
+        )
+
+    def test_suppression_needs_justification(self):
+        src = """
+        class Manager:
+            def drift_tick(self):  # agac-lint: ignore[cross-shard-sweep] -- single-process tick by design
+                for obj in self.lister.list():
+                    self.enqueue(obj)
+        """
+        assert run(src, path="agac_tpu/manager.py") == []
+        bare = src.replace(" -- single-process tick by design", "")
+        violations = run(bare, path="agac_tpu/manager.py")
+        assert violations, "suppression without justification must not hold"
+
+
 def test_rule_registry_ships_the_documented_rules():
     ids = {r.id for r in RULES}
     assert ids == {
@@ -873,6 +982,7 @@ def test_rule_registry_ships_the_documented_rules():
         "delete-without-ownership-check",
         "unregistered-metric",
         "unseamed-clock",
+        "cross-shard-sweep",
     }
 
 

@@ -33,6 +33,11 @@ TINY_ENV = {
     "AGAC_BENCH_WORKERS": "4",
     "AGAC_BENCH_STEADY_WINDOW": "0.5",
     "AGAC_BENCH_DRIFT_N": "12",
+    # sharding phase (ISSUE 8): tiny fleet + light latency shaping so
+    # the two subprocess runs finish in seconds; the 1.7x speedup gate
+    # only arms at full scale (>= 100 objects)
+    "AGAC_BENCH_SHARD_N": "10",
+    "AGAC_BENCH_SHARD_LATENCY": "0.05",
 }
 
 
@@ -133,6 +138,53 @@ def test_detail_artifact_written_and_complete(bench_run, detail_path):
     assert batching["submissions"] >= 1
     # batching can never INCREASE the wire-call count
     assert batching["wire_calls"] <= batching["submissions"]
+
+
+def test_sharding_block_exported_and_quota_respected(bench_run, detail_path):
+    """The 2-shard multi-process phase (ISSUE 8): the ``sharding``
+    block carries both runs' throughput plus per-replica telemetry,
+    and the quota-division contract holds — the fleet AGGREGATE call
+    rate per service, and the live replicas' summed AIMD ceilings,
+    never exceed the global budget."""
+    with open(detail_path) as f:
+        detail = json.load(f)
+    sharding = detail["sharding"]
+    for key in ("single", "sharded", "speedup", "quota_budget_per_service_qps"):
+        assert key in sharding, f"sharding block missing {key!r}"
+    budget = sharding["quota_budget_per_service_qps"]
+    single, sharded = sharding["single"], sharding["sharded"]
+    assert single["shard_count"] == 1 and single["replicas"] == 1
+    assert sharded["shard_count"] == 2 and sharded["replicas"] == 2
+    for run in (single, sharded):
+        assert run["objects_per_sec"] > 0
+        assert run["aws_calls_by_service"].get("globalaccelerator", 0) > 0
+        # the aggregate AWS call rate never exceeds the global budget
+        for service, rate in run["aggregate_calls_per_sec_by_service"].items():
+            assert rate <= budget * 1.001, (
+                f"{service} aggregate {rate}/s over budget {budget}/s"
+            )
+    # both runs converged the same fleet over real subprocesses
+    assert sharded["n_objects"] == single["n_objects"]
+    # divided quota, structurally: every live replica's ceiling is a
+    # fraction of the budget and the sum stays within it
+    ceiling_sums = {}
+    for replica in sharded["per_replica"]:
+        for service, ceiling in replica["aimd_ceilings"].items():
+            ceiling_sums[service] = ceiling_sums.get(service, 0.0) + ceiling
+    assert ceiling_sums, "per-replica AIMD ceilings missing"
+    for service, total in ceiling_sums.items():
+        assert total <= budget * 1.001, (
+            f"{service} summed ceilings {total}/s over budget {budget}/s"
+        )
+    # exclusive ownership at the process level: owned shard sets of the
+    # two replicas never overlap
+    owned = [set(replica["owned_shards"]) for replica in sharded["per_replica"]]
+    assert owned[0] & owned[1] == set(), owned
+    assert set().union(*owned) == {0, 1}
+    # the headline carries the scale-out summary
+    lines = [ln for ln in bench_run.stdout.splitlines() if ln.strip()]
+    headline = json.loads(lines[-1])
+    assert headline["sharding"]["speedup"] == sharding["speedup"]
 
 
 def test_metrics_snapshot_scraped_per_phase(bench_run, detail_path):

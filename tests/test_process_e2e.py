@@ -17,11 +17,14 @@ the durable state file that the successor converges to zero orphans —
 including the case only the GC sweeper can fix (a Service whose
 delete event died with the old process)."""
 
+import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
+import urllib.request
 
 
 import yaml
@@ -29,6 +32,7 @@ import yaml
 from agac_tpu.cloudprovider.aws.fake_backend import FileBackedFakeAWSBackend
 from agac_tpu.cluster.rest import RestClusterClient
 from agac_tpu.cluster.testserver import TestApiServer
+from agac_tpu.sharding import HashRing
 
 from agac_tpu import apis
 
@@ -470,5 +474,142 @@ class TestKillRecoveryDrills:
                     f"records={drill.record_names('example.com')}\n{_dump(standby)}"
                 )
                 assert drill.terminate(standby) == 0
+            finally:
+                drill.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# two-shard multi-process drill (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+SHARD_ARGS = ("--shard-count", "2", "--shards-per-replica", "2")
+
+# the default drill lease (1.5 s) is too twitchy for two busy python
+# processes sharing a loaded CI core: a GIL pause past the duration
+# reads as a crash and triggers a spurious steal mid-convergence.
+# 4 s keeps failover sub-5 s while tolerating scheduler hiccups.
+SHARD_LEASE_ENV = {
+    "AGAC_LEASE_DURATION": "4",
+    "AGAC_LEASE_RENEW_DEADLINE": "2",
+    "AGAC_LEASE_RETRY_PERIOD": "0.3",
+}
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def healthz_sharding(port: int) -> dict | None:
+    """The /healthz sharding block of one controller process, or None
+    while the endpoint (or the membership) is not up yet."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=2
+        ) as response:
+            return json.loads(response.read())["sharding"]
+    except Exception:
+        return None
+
+
+class TestTwoShardProcessDrill:
+    def test_two_live_replicas_split_keyspace_and_survive_kill(self, tmp_path):
+        """Two REAL controller processes run concurrently under
+        --shard-count 2 (no single active leader): every shard lease is
+        held, the processes' owned sets never overlap (/healthz is the
+        witness), the fleet converges through the multi-writer durable
+        fake, and when the replica holding a shard is hard-killed the
+        survivor steals the expired lease, adopts the orphaned
+        keyspace, and finishes both the leaked work and new keys."""
+        n = 8
+        ring = HashRing(2)
+        with TestApiServer() as server:
+            drill = Drill(tmp_path, server)
+            ports = [free_port(), free_port()]
+            procs = []
+            try:
+                for port in ports:
+                    procs.append(
+                        drill.start(
+                            args=(*SHARD_ARGS, "--health-port", str(port)),
+                            leader_election=True,  # sharded mode ignores the single-leader lease
+                            extra_env=SHARD_LEASE_ENV,
+                        )
+                    )
+
+                def shard_views():
+                    views = [healthz_sharding(port) for port in ports]
+                    if any(v is None or not v.get("enabled") for v in views):
+                        return None
+                    return views
+
+                # both processes up, every shard lease held by someone
+                def all_shards_held():
+                    views = shard_views()
+                    if views is None:
+                        return False
+                    owned = [set(v["owned"]) for v in views]
+                    return set().union(*owned) == {0, 1}
+
+                assert wait_until(all_shards_held, timeout=30.0), (
+                    _dump(procs[0]) + _dump(procs[1])
+                )
+                # exclusive ownership at the process level — the
+                # no-key-owned-by-two-shards oracle's real-world twin
+                views = shard_views()
+                owned = [set(v["owned"]) for v in views]
+                assert owned[0] & owned[1] == set(), owned
+
+                for i in range(n):
+                    drill.client.create(
+                        "Service", make_lb_service(name=f"svc-{i:02d}")
+                    )
+
+                def chains_complete(expected):
+                    accelerators, listeners, groups = drill.aws().chain_counts()
+                    return accelerators == listeners == groups == expected
+
+                assert wait_until(
+                    lambda: chains_complete(n), timeout=60.0
+                ), f"fleet did not converge: {drill.aws().chain_counts()}"
+
+                # kill the replica that owns shard 0 (kill -9: leases
+                # NOT released)
+                views = shard_views()
+                victim_index = next(
+                    i for i, view in enumerate(views) if 0 in view["owned"]
+                )
+                survivor_port = ports[1 - victim_index]
+                procs[victim_index].kill()
+                procs[victim_index].wait(10)
+
+                # a key in the DEAD replica's keyspace, created while
+                # nobody owns it: only the steal + reshard resync can
+                # pick it up
+                orphan_name = next(
+                    f"late-{i}"
+                    for i in range(100)
+                    if ring.shard_for("default", f"late-{i}") == 0
+                )
+                drill.client.create(
+                    "Service", make_lb_service(name=orphan_name)
+                )
+
+                def survivor_owns_all():
+                    view = healthz_sharding(survivor_port)
+                    return view is not None and set(view["owned"]) == {0, 1}
+
+                assert wait_until(survivor_owns_all, timeout=30.0), (
+                    healthz_sharding(survivor_port)
+                )
+                assert wait_until(
+                    lambda: chains_complete(n + 1), timeout=60.0
+                ), f"adopted keyspace not converged: {drill.aws().chain_counts()}"
+                # the survivor's map shows the takeover and its doubled
+                # quota slice
+                view = healthz_sharding(survivor_port)
+                assert view["quota_fraction"] == 1.0
+                assert view["live_shards"] == 2
             finally:
                 drill.stop_all()

@@ -1,0 +1,553 @@
+"""Unit tier for the horizontal sharding plane (ISSUE 8,
+``agac_tpu/sharding/``).
+
+Four surfaces, each with the property the tentpole's safety argument
+leans on:
+
+- **ring** — deterministic partitioning (every replica derives the
+  identical map), rough balance, and the ~1/N movement bound on
+  resize that makes shard-count changes an incremental migration
+  instead of a full reshuffle;
+- **membership** — lease acquire/renew/steal on a fake clock, with
+  the exclusivity invariant held at every step: a FRESH lease is
+  never stolen, a lost CAS drops the shard immediately, capacity is
+  respected, clean release hands over without waiting out the lease;
+- **quota division** — the AIMD ceilings rebalance with ownership and
+  the fleet AGGREGATE never exceeds the global budget across
+  membership churn (including mid-failover, when a shard's budget is
+  briefly owned by nobody);
+- **shard-filtered GC** — a sweeper only partitions candidates from
+  its own keyspace: foreign orphans are neither deleted nor even
+  grace-counted, and a replica owning nothing never sweeps at all.
+
+Plus the per-shard report merge (the single-owner-assumption fix):
+two shards' partial drift/GC reports merge additively instead of
+last-writer-wins.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
+from agac_tpu.cloudprovider.aws.health import AIMDLimiter, HealthConfig, HealthTracker
+from agac_tpu.cluster import FakeCluster, SharedInformerFactory
+from agac_tpu.controllers import GarbageCollector, GarbageCollectorConfig
+from agac_tpu.leaderelection import LeaderElectionConfig
+from agac_tpu.manager import Manager
+from agac_tpu.sharding import (
+    OWNS_ALL,
+    HashRing,
+    ShardFilter,
+    ShardMembership,
+    ShardingConfig,
+)
+from agac_tpu.sharding.reports import merge_shard_reports
+
+from .fixtures import NLB_REGION, make_lb_service
+
+
+# ---------------------------------------------------------------------------
+# ring
+# ---------------------------------------------------------------------------
+
+
+class TestHashRing:
+    def test_deterministic_across_instances(self):
+        keys = [f"ns-{i}/svc-{i}" for i in range(500)]
+        a, b = HashRing(4), HashRing(4)
+        assert [a.shard_for_key(k) for k in keys] == [
+            b.shard_for_key(k) for k in keys
+        ]
+
+    def test_key_form_matches_namespace_name_form(self):
+        ring = HashRing(8)
+        assert ring.shard_for("default", "web") == ring.shard_for_key("default/web")
+
+    def test_rough_balance_over_uniform_keys(self):
+        ring = HashRing(4)
+        keys = [f"default/svc-{i:05d}" for i in range(5000)]
+        buckets = ring.partition(keys)
+        fair = len(keys) / ring.shard_count
+        for shard, owned in buckets.items():
+            assert 0.5 * fair <= len(owned) <= 1.6 * fair, (
+                f"shard {shard} owns {len(owned)} of {len(keys)} "
+                f"(fair share {fair:.0f})"
+            )
+
+    def test_resize_moves_about_one_nth(self):
+        keys = [f"default/svc-{i:05d}" for i in range(5000)]
+        before, after = HashRing(4), HashRing(5)
+        moved = sum(
+            1 for k in keys if before.shard_for_key(k) != after.shard_for_key(k)
+        )
+        # ideal movement is 1/5 of the keyspace; a modulo partitioner
+        # would move ~4/5.  Pin "consistent", with slack for vnode
+        # placement variance.
+        assert 0.05 * len(keys) <= moved <= 0.35 * len(keys), moved
+
+    def test_single_shard_owns_everything(self):
+        ring = HashRing(1)
+        assert {ring.shard_for_key(f"ns/{i}") for i in range(100)} == {0}
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError):
+            HashRing(0)
+        with pytest.raises(ValueError):
+            HashRing(2, vnodes=0)
+
+    def test_version_identifies_the_map(self):
+        assert HashRing(4).version == HashRing(4).version
+        assert HashRing(4).version != HashRing(5).version
+
+
+# ---------------------------------------------------------------------------
+# filter
+# ---------------------------------------------------------------------------
+
+
+class TestShardFilter:
+    def test_owns_all_is_single_shard_semantics(self):
+        assert OWNS_ALL.owns("any-ns", "any-name")
+        assert OWNS_ALL.owns_key("whatever/key")
+        assert OWNS_ALL.token() == "all"
+        assert OWNS_ALL.owned_shards() == frozenset({0})
+
+    def test_filter_partitions_exactly_by_ring(self):
+        ring = HashRing(3)
+        owned = frozenset({1})
+        shard_filter = ShardFilter(ring, lambda: owned)
+        for i in range(200):
+            key = f"default/svc-{i}"
+            assert shard_filter.owns_key(key) == (ring.shard_for_key(key) == 1)
+
+    def test_token_tracks_live_ownership(self):
+        owned = {"value": frozenset()}
+        shard_filter = ShardFilter(HashRing(4), lambda: owned["value"])
+        assert shard_filter.token() == "none"
+        owned["value"] = frozenset({2, 0})
+        assert shard_filter.token() == "0,2"
+
+
+# ---------------------------------------------------------------------------
+# membership (fake clock, FakeCluster leases)
+# ---------------------------------------------------------------------------
+
+FAST_LEASE = LeaderElectionConfig(
+    lease_duration=6.0, renew_deadline=2.0, retry_period=1.0
+)
+
+
+class MembershipWorld:
+    """N replicas' memberships over one shared FakeCluster, ticked
+    explicitly on a fake clock — the cooperative form the sim harness
+    schedules, without a scheduler."""
+
+    def __init__(self, shard_count=2, capacity=2, replicas=("a", "b")):
+        self.cluster = FakeCluster()
+        self.now = 0.0
+        config = ShardingConfig(
+            shard_count=shard_count,
+            shards_per_replica=capacity,
+            lease=FAST_LEASE,
+        )
+        self.members = {
+            identity: ShardMembership(
+                config, identity=identity, clock=lambda: self.now
+            )
+            for identity in replicas
+        }
+
+    def tick(self, *identities):
+        for identity in identities or self.members:
+            self.members[identity].tick(self.cluster)
+
+    def advance(self, seconds: float):
+        self.now += seconds
+
+    def owned(self, identity: str) -> set:
+        return set(self.members[identity].owned_shards())
+
+    def assert_exclusive(self):
+        seen: dict[int, str] = {}
+        for identity, member in self.members.items():
+            for shard in member.owned_shards():
+                assert shard not in seen, (
+                    f"shard {shard} owned by both {seen[shard]} and {identity}"
+                )
+                seen[shard] = identity
+
+
+class TestShardMembership:
+    def test_one_claim_per_tick_balances_two_replicas(self):
+        world = MembershipWorld()
+        world.tick("a", "b")
+        assert world.owned("a") == {0}
+        assert world.owned("b") == {1}
+        world.assert_exclusive()
+
+    def test_fresh_lease_never_stolen(self):
+        world = MembershipWorld()
+        world.tick("a", "b")
+        # both keep renewing every retry_period: ownership is stable
+        for _ in range(20):
+            world.advance(FAST_LEASE.retry_period)
+            world.tick("a", "b")
+            assert world.owned("a") == {0} and world.owned("b") == {1}
+            world.assert_exclusive()
+
+    def test_expired_lease_stolen_and_counted(self):
+        world = MembershipWorld()
+        world.tick("a", "b")
+        steals_before = world.members["a"]._m_steals.value()
+        # b crashes (stops ticking); a steals only after the lease
+        # expires on a's local observation clock
+        for _ in range(int(FAST_LEASE.lease_duration) - 1):
+            world.advance(1.0)
+            world.tick("a")
+            world.assert_exclusive()
+        assert world.owned("a") == {0}, "lease must not be stolen while fresh"
+        world.advance(2.0)
+        world.tick("a")
+        assert world.owned("a") == {0, 1}
+        assert world.members["a"]._m_steals.value() == steals_before + 1
+
+    def test_lost_cas_drops_shard_immediately(self):
+        world = MembershipWorld()
+        world.tick("a", "b")
+        # b pauses; a keeps ticking — the steal lands one full
+        # lease_duration after a FIRST OBSERVED b's record (client-go
+        # observed-time semantics, so a single late tick can't steal)
+        for _ in range(int(FAST_LEASE.lease_duration) + 2):
+            world.advance(1.0)
+            world.tick("a")
+        assert world.owned("a") == {0, 1}
+        # b wakes up and ticks: its renew CAS must fail against a's
+        # fresh hold and b must drop the shard in the same tick
+        world.tick("b")
+        assert world.owned("b") == set()
+        world.assert_exclusive()
+
+    def test_clean_release_hands_over_without_expiry_wait(self):
+        world = MembershipWorld()
+        world.tick("a", "b")
+        world.members["b"].release_all(world.cluster)
+        assert world.owned("b") == set()
+        # a claims the released lease on its next tick — no
+        # lease_duration wait
+        world.advance(FAST_LEASE.retry_period)
+        world.tick("a")
+        assert world.owned("a") == {0, 1}
+
+    def test_capacity_cap_respected(self):
+        world = MembershipWorld(shard_count=4, capacity=1, replicas=("a",))
+        for _ in range(10):
+            world.tick("a")
+            world.advance(FAST_LEASE.retry_period)
+        assert len(world.owned("a")) == 1
+
+    def test_quota_fraction_follows_ownership(self):
+        world = MembershipWorld()
+        assert world.members["a"].quota_fraction() == 0.0
+        world.tick("a", "b")
+        assert world.members["a"].quota_fraction() == 0.5
+        for _ in range(int(FAST_LEASE.lease_duration) + 2):
+            world.advance(1.0)
+            world.tick("a")
+        assert world.members["a"].quota_fraction() == 1.0
+
+    def test_shard_map_publishes_observed_holders(self):
+        world = MembershipWorld()
+        world.tick("a", "b")
+        world.tick("a")  # a's capacity probe observes b's hold
+        shard_map = world.members["a"].shard_map()
+        assert shard_map["owned"] == [0]
+        assert shard_map["holders"]["0"] == "a"
+        assert shard_map["holders"]["1"] == "b"
+        assert shard_map["live_shards"] == 2
+        assert shard_map["ring"] == "2x64"
+
+    def test_on_change_fires_per_ownership_change(self):
+        changes = []
+        config = ShardingConfig(shard_count=2, lease=FAST_LEASE)
+        cluster = FakeCluster()
+        member = ShardMembership(
+            config, identity="solo", clock=lambda: 0.0,
+            on_change=lambda m: changes.append(sorted(m.owned_shards())),
+        )
+        member.tick(cluster)
+        member.tick(cluster)
+        member.tick(cluster)  # no further change once both are held
+        assert changes == [[0], [0, 1]]
+
+
+# ---------------------------------------------------------------------------
+# quota division (the health plane's AIMD seam)
+# ---------------------------------------------------------------------------
+
+
+class TestQuotaDivision:
+    def test_set_ceiling_clamps_live_rate_down(self):
+        limiter = AIMDLimiter(qps=20.0, floor=0.5)
+        assert limiter.rate() == 20.0
+        limiter.set_ceiling(5.0)
+        assert limiter.ceiling() == 5.0
+        assert limiter.rate() == 5.0
+        # growth is earned back additively, capped at the new ceiling
+        for _ in range(100):
+            limiter.on_success()
+        assert limiter.rate() == 5.0
+
+    def test_set_ceiling_floor_clamped(self):
+        limiter = AIMDLimiter(qps=20.0, floor=0.5)
+        limiter.set_ceiling(0.0)
+        assert limiter.ceiling() == 0.5
+
+    def test_tracker_rebalances_existing_and_future_services(self):
+        tracker = HealthTracker(
+            config=HealthConfig(aimd_qps=20.0), sleep=lambda s: None
+        )
+        existing = tracker.service("globalaccelerator")
+        tracker.set_quota_fraction(0.25)
+        assert existing.limiter.ceiling() == 5.0
+        later = tracker.service("route53")
+        assert later.limiter.ceiling() == 5.0
+        assert tracker.quota_fraction() == 0.25
+        assert existing.snapshot()["aimd_ceiling"] == 5.0
+
+    def test_aggregate_never_exceeds_global_budget_across_churn(self):
+        """Two replicas' trackers, driven by their memberships through
+        every phase of a failover: the sum of LIVE shard-owner
+        ceilings stays <= the global budget at every step (the
+        mid-steal dip is unowned budget, never double-counted; a dead
+        replica's stale owned set counts for nothing because nothing
+        of it runs)."""
+        global_qps = 20.0
+        world = MembershipWorld()
+        live = {"a", "b"}
+        trackers = {
+            identity: HealthTracker(
+                config=HealthConfig(aimd_qps=global_qps), sleep=lambda s: None
+            )
+            for identity in world.members
+        }
+        for identity, member in world.members.items():
+            tracker = trackers[identity]
+            member.on_change = (
+                lambda m, t=tracker: t.set_quota_fraction(m.quota_fraction())
+            )
+            tracker.set_quota_fraction(0.0)
+
+        def aggregate_owner_ceiling() -> float:
+            total = 0.0
+            for identity, member in world.members.items():
+                if identity not in live or not member.owned_shards():
+                    continue  # dead replicas run nothing; ownerless idle
+                service = trackers[identity].service("globalaccelerator")
+                total += service.limiter.ceiling()
+            return total
+
+        assert aggregate_owner_ceiling() == 0.0
+        world.tick("a", "b")  # balanced: 10 + 10
+        assert aggregate_owner_ceiling() == pytest.approx(global_qps)
+        # b crashes; until the steal lands, its budget is simply unowned
+        live.discard("b")
+        for _ in range(int(FAST_LEASE.lease_duration) + 2):
+            world.advance(1.0)
+            world.tick("a")
+            assert aggregate_owner_ceiling() <= global_qps + 1e-9
+        # post-failover: a owns everything at the full global budget
+        assert world.owned("a") == {0, 1}
+        assert aggregate_owner_ceiling() == pytest.approx(global_qps)
+
+    def test_revived_replica_drops_budget_with_its_shards(self):
+        """The resurrection case: a replica paused past its lease
+        expiry wakes up AFTER its shards were stolen — its very next
+        tick fails the renew CAS, drops the shards, and its quota
+        fraction collapses to zero, so the post-revival aggregate is
+        back under the global budget within one tick."""
+        global_qps = 20.0
+        world = MembershipWorld()
+        trackers = {
+            identity: HealthTracker(
+                config=HealthConfig(aimd_qps=global_qps), sleep=lambda s: None
+            )
+            for identity in world.members
+        }
+        for identity, member in world.members.items():
+            member.on_change = (
+                lambda m, t=trackers[identity]: t.set_quota_fraction(
+                    m.quota_fraction()
+                )
+            )
+        world.tick("a", "b")
+        for _ in range(int(FAST_LEASE.lease_duration) + 2):
+            world.advance(1.0)
+            world.tick("a")  # b paused; a steals shard 1
+        assert world.owned("a") == {0, 1}
+        world.tick("b")  # b revives: CAS fails, shard + budget dropped
+        assert world.owned("b") == set()
+        assert trackers["b"].quota_fraction() == 0.0
+        total = sum(
+            trackers[i].service("ga").limiter.ceiling()
+            for i in world.members
+            if world.owned(i)
+        )
+        assert total == pytest.approx(global_qps)
+
+
+# ---------------------------------------------------------------------------
+# shard-filtered GC candidate partition
+# ---------------------------------------------------------------------------
+
+
+def nlb_hostname(i: int) -> str:
+    return f"lb{i}-0123456789abcdef.elb.{NLB_REGION}.amazonaws.com"
+
+
+class GCWorld:
+    """The test_gc_sweeper World, narrowed to the partition surface."""
+
+    def __init__(self):
+        self.cluster = FakeCluster()
+        self.aws = FakeAWSBackend(quota_accelerators=100)
+        self.zone = self.aws.add_hosted_zone("example.com")
+        self.stop = threading.Event()
+        self.factory = SharedInformerFactory(self.cluster, resync_period=30.0)
+        self.factory.informer("Service")
+        self.factory.informer("Ingress")
+        self.factory.start(self.stop)
+        assert self.factory.wait_for_cache_sync(self.stop)
+        self.driver = AWSDriver(
+            self.aws, self.aws, self.aws, poll_interval=0.01, poll_timeout=2.0
+        )
+
+    def gc(self, shard_filter=None, **overrides) -> GarbageCollector:
+        overrides.setdefault("grace_sweeps", 1)
+        config = GarbageCollectorConfig(interval=0.01, **overrides)
+        return GarbageCollector(
+            self.factory, config, lambda region: self.driver,
+            shard_filter=shard_filter,
+        )
+
+    def make_orphan(self, name: str, i: int):
+        self.aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+        svc = make_lb_service(name=name, hostname=nlb_hostname(i))
+        arn, _, _ = self.driver.ensure_global_accelerator_for_service(
+            svc, svc.status.load_balancer.ingress[0], "default", f"lb{i}", NLB_REGION
+        )
+        return arn
+
+
+@pytest.fixture
+def gc_world():
+    world = GCWorld()
+    yield world
+    world.stop.set()
+
+
+class TestShardFilteredGC:
+    def test_sweeper_only_partitions_owned_candidates(self, gc_world):
+        ring = HashRing(2)
+        orphans = {}
+        for i in range(8):
+            name = f"ghost{i}"
+            orphans[name] = gc_world.make_orphan(name, i)
+        owned_names = {
+            name for name in orphans if ring.shard_for("default", name) == 0
+        }
+        assert 0 < len(owned_names) < len(orphans), "need a real split"
+        shard_filter = ShardFilter(ring, lambda: frozenset({0}))
+        report = gc_world.gc(shard_filter=shard_filter).sweep_once()
+        assert report["shards"] == "0"
+        assert report["candidates"]["accelerators"] == len(owned_names)
+        assert report["deleted"]["accelerators"] == len(owned_names)
+        # foreign-shard orphans survive untouched — the other shard's
+        # sweeper owns them
+        survivors = set(gc_world.aws.all_accelerator_arns())
+        assert survivors == {
+            arn for name, arn in orphans.items() if name not in owned_names
+        }
+
+    def test_foreign_candidates_accrue_no_grace_state(self, gc_world):
+        ring = HashRing(2)
+        gc_world.make_orphan("ghost0", 0)
+        foreign_shard = 1 - ring.shard_for("default", "ghost0")
+        shard_filter = ShardFilter(ring, lambda: frozenset({foreign_shard}))
+        gc = gc_world.gc(shard_filter=shard_filter, grace_sweeps=2)
+        for _ in range(3):
+            report = gc.sweep_once()
+            assert report["candidates"] == {"accelerators": 0, "records": 0}
+        assert gc._pending_accelerators == {}
+
+    def test_replica_owning_nothing_never_sweeps(self, gc_world):
+        gc_world.make_orphan("ghost0", 0)
+        calls_before = len(gc_world.aws.calls)
+        shard_filter = ShardFilter(HashRing(2), lambda: frozenset())
+        report = gc_world.gc(shard_filter=shard_filter).sweep_once()
+        assert report["skipped_no_shards"] is True
+        assert report["candidates"] == {"accelerators": 0, "records": 0}
+        assert len(gc_world.aws.calls) == calls_before, (
+            "a shardless replica must not spend quota enumerating"
+        )
+
+
+# ---------------------------------------------------------------------------
+# per-shard report merge (the single-owner-assumption fix)
+# ---------------------------------------------------------------------------
+
+
+class TestPerShardReports:
+    def test_merge_adds_counts_unions_lists_ors_bools(self):
+        merged = merge_shard_reports(
+            {
+                "0": {
+                    "shards": "0",
+                    "enqueued": {"ga": 2},
+                    "skipped": {},
+                    "partial": False,
+                    "listing_failed": ["records"],
+                },
+                "1": {
+                    "shards": "1",
+                    "enqueued": {"ga": 3, "r53": 1},
+                    "skipped": {"r53": ["route53"]},
+                    "partial": True,
+                    "listing_failed": ["records", "accelerators"],
+                },
+            }
+        )
+        assert merged == {
+            "enqueued": {"ga": 5, "r53": 1},
+            "skipped": {"r53": ["route53"]},
+            "partial": True,
+            "listing_failed": ["records", "accelerators"],
+        }
+
+    def test_drift_reports_keyed_per_shard_not_overwritten(self):
+        class FakeController:
+            DRIFT_SERVICES = ()
+
+            def __init__(self):
+                self.enqueued = []
+
+            def drift_resync_sources(self):
+                class Lister:
+                    @staticmethod
+                    def list():
+                        return ["x", "y"]
+
+                return [(Lister, lambda o: True, self.enqueued.append)]
+
+        manager = Manager()
+        manager.controllers = {"c": FakeController()}
+        manager.shard_filter = ShardFilter(HashRing(2), lambda: frozenset({0}))
+        manager.drift_tick()
+        manager.shard_filter = ShardFilter(HashRing(2), lambda: frozenset({1}))
+        manager.drift_tick()
+        assert set(manager.last_drift_reports) == {"0", "1"}
+        # the merged legacy view ADDS the two partials instead of
+        # showing whichever shard ticked last
+        assert manager.last_drift_report["enqueued"] == {"c": 4}

@@ -1,0 +1,269 @@
+"""Sim-harness tiers for the horizontal sharding plane (ISSUE 8):
+two-shard fleets of concurrently-LIVE replicas on virtual time.
+
+Fast tier (tier-1): balanced two-shard convergence, the
+shard-lease-failover drill (kill one replica; the survivor steals the
+expired lease, adopts the orphaned keyspace via the reshard resync,
+and converges under the full oracle battery plus the new
+exclusive-ownership oracle), graceful handover, crash-at-API-boundary
+recovery, sim quota division, byte-identical replay, and the
+oracle-catches-overlap canary.
+
+Slow tier (the CI ``sim`` job): the acceptance soak — N=50k services
+across two shards with a mid-run shard failover, deterministic from
+seed (the replay identity is pinned by the fast tier; the soak pins
+scale and the oracle battery).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from agac_tpu.cloudprovider.aws.health import HealthConfig
+from agac_tpu.leaderelection import LeaderElectionConfig
+from agac_tpu.sim import fuzz
+from agac_tpu.sim.harness import SimHarness, SimHarnessConfig
+from agac_tpu.sim.oracles import (
+    check_exclusive_shard_ownership,
+    standard_oracles,
+)
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+from .test_chaos_e2e import nlb_hostname
+
+LEASE = LeaderElectionConfig(
+    lease_duration=60.0, renew_deadline=15.0, retry_period=5.0
+)
+
+
+def sharded_config(**overrides) -> SimHarnessConfig:
+    defaults = dict(
+        replicas=2,
+        shard_count=2,
+        # capacity 2 so the survivor CAN adopt the whole keyspace;
+        # the one-claim-per-tick rule still balances the start 1+1
+        shards_per_replica=2,
+        lease=LEASE,
+    )
+    defaults.update(overrides)
+    return SimHarnessConfig(**defaults)
+
+
+def seed_fleet(harness, n: int) -> None:
+    harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+    for i in range(n):
+        harness.cluster.create("Service", make_lb_service(name=f"svc-{i:05d}"))
+
+
+def converge(harness, timeout=7200.0):
+    harness.run_for(30.0)
+    assert harness.run_until_quiescent(timeout, settle_window=60.0), (
+        f"world still busy: {harness.stats()}"
+    )
+
+
+class TestTwoShardConvergence:
+    def test_fleet_splits_across_replicas_and_converges(self):
+        with SimHarness(config=sharded_config()) as harness:
+            seed_fleet(harness, 40)
+            converge(harness)
+            ownership = harness.shard_ownership()
+            assert sorted(
+                shard for owned in ownership.values() for shard in owned
+            ) == [0, 1]
+            assert all(len(owned) == 1 for owned in ownership.values()), (
+                "one-claim-per-tick must balance two replicas 1+1"
+            )
+            assert len(harness.aws.all_accelerator_arns()) == 40
+            assert standard_oracles(harness) == []
+
+    def test_both_replicas_did_real_work(self):
+        """The point of sharding: BOTH replicas reconcile — each owns
+        a non-trivial slice of the keyspace."""
+        with SimHarness(config=sharded_config()) as harness:
+            seed_fleet(harness, 40)
+            converge(harness)
+            depths = []
+            for stack in harness.live_stacks():
+                manager = stack.manager
+                keys = manager._count_owned_keys()
+                depths.append(keys)
+            assert sum(depths) == 40
+            assert all(keys >= 5 for keys in depths), depths
+
+    def test_sim_quota_division_sums_to_global(self):
+        global_qps = 40.0
+        config = sharded_config(
+            health=HealthConfig(aimd_qps=global_qps, min_calls=1000)
+        )
+        with SimHarness(config=config) as harness:
+            seed_fleet(harness, 20)
+            converge(harness)
+            ceilings = [
+                replica.world.health.service("globalaccelerator").limiter.ceiling()
+                for replica in harness.live_replicas()
+            ]
+            assert ceilings == [global_qps / 2, global_qps / 2]
+            assert sum(ceilings) <= global_qps
+
+
+class TestShardFailover:
+    def test_kill_replica_survivor_steals_adopts_converges(self):
+        """The drill the ISSUE names: kill one replica mid-fleet; the
+        survivor steals the expired shard lease, adopts the orphaned
+        keyspace (reshard resync — those keys' events died with the
+        victim), takes over the victim's quota slice, and the world
+        converges under every oracle including exclusive ownership."""
+        global_qps = 40.0
+        config = sharded_config(
+            health=HealthConfig(aimd_qps=global_qps, min_calls=1000)
+        )
+        with SimHarness(config=config) as harness:
+            seed_fleet(harness, 30)
+            harness.run_for(30.0)
+            killed = harness.kill_shard_replica()
+            # keys created AFTER the kill, in the dead replica's former
+            # keyspace, must be picked up by the survivor post-steal
+            for i in range(30, 40):
+                harness.cluster.create(
+                    "Service", make_lb_service(name=f"svc-{i:05d}")
+                )
+            harness.run_for(LEASE.lease_duration + 3 * LEASE.retry_period)
+            ownership = harness.shard_ownership()
+            assert list(ownership) == [
+                replica.identity for replica in harness.live_replicas()
+            ]
+            survivor_owned = next(iter(ownership.values()))
+            assert survivor_owned == frozenset({0, 1}), (
+                f"survivor must steal {killed}'s lease: {ownership}"
+            )
+            converge(harness)
+            assert len(harness.aws.all_accelerator_arns()) == 40
+            assert standard_oracles(harness) == []
+            # the victim's quota slice moved with its lease
+            survivor = harness.live_replicas()[0]
+            assert survivor.world.health.service(
+                "globalaccelerator"
+            ).limiter.ceiling() == pytest.approx(global_qps)
+
+    def test_graceful_stop_hands_over_without_lease_wait(self):
+        with SimHarness(config=sharded_config()) as harness:
+            seed_fleet(harness, 10)
+            harness.run_for(30.0)
+            harness.stop_shard_replica()
+            # released leases are claimable immediately: well under one
+            # lease_duration the survivor owns everything
+            harness.run_for(3 * LEASE.retry_period)
+            ownership = harness.shard_ownership()
+            assert list(ownership.values()) == [frozenset({0, 1})]
+            converge(harness)
+            assert standard_oracles(harness) == []
+
+    def test_crash_at_api_boundary_kills_only_that_replica(self):
+        """A SimulatedCrash raised inside one replica's worker is that
+        replica's process death: its stack vanishes, its leases stay
+        held, the pool is replenished, and the fleet still converges."""
+        with SimHarness(config=sharded_config()) as harness:
+            harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+            harness.fault_plan.crash("create_listener", when="before")
+            for i in range(20):
+                harness.cluster.create(
+                    "Service", make_lb_service(name=f"svc-{i:05d}")
+                )
+            harness.run_for(LEASE.lease_duration + 5 * LEASE.retry_period)
+            converge(harness)
+            assert len(harness.aws.all_accelerator_arns()) == 20
+            assert standard_oracles(harness) == []
+
+    def test_replay_is_byte_identical(self):
+        def run():
+            with SimHarness(config=sharded_config()) as harness:
+                seed_fleet(harness, 25)
+                harness.run_for(30.0)
+                harness.kill_shard_replica()
+                harness.run_until_quiescent(7200.0, settle_window=60.0)
+                return harness.trace_hash(), len(harness.aws.all_accelerator_arns())
+
+        first, second = run(), run()
+        assert first == second
+        assert first[1] == 25
+
+
+class TestExclusiveOwnershipOracle:
+    def test_oracle_catches_forced_overlap(self):
+        """A canary for the oracle itself: force two live memberships
+        to claim the same shard and the violation must surface —
+        an oracle that can't fail proves nothing."""
+        with SimHarness(config=sharded_config()) as harness:
+            seed_fleet(harness, 4)
+            harness.run_for(30.0)
+            for replica in harness.live_replicas():
+                replica.stack.manager.shard_membership._publish({0, 1})
+            harness.check_exclusive_ownership()
+            violations = check_exclusive_shard_ownership(harness)
+            assert violations, "forced overlap must be caught"
+            assert any("owned by BOTH" in v for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance soak: N=50k, two shards, mid-run failover (CI sim job)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestTwoShardSoak:
+    def test_fifty_k_two_shard_soak_with_failover(self):
+        n = 50_000
+        start_wall = time.monotonic()
+        config = sharded_config(
+            resync_period=6 * 3600.0,
+            settle_poll_interval=30.0,
+            discovery_ttl=300.0,
+            quota_accelerators=n + 50,
+            lease=LeaderElectionConfig(
+                lease_duration=120.0, renew_deadline=60.0, retry_period=30.0
+            ),
+            health=HealthConfig(
+                window=60.0,
+                min_calls=1000,  # breakers armed but not twitchy at scale
+                failure_ratio=0.5,
+                open_duration=30.0,
+                probe_budget=1,
+                aimd_qps=400.0,
+            ),
+        )
+        with SimHarness(config=config) as harness:
+            for i in range(n):
+                harness.aws.add_load_balancer(f"lb{i}", NLB_REGION, nlb_hostname(i))
+
+            def creator():
+                # the fleet rolls out across the first two virtual hours
+                for i in range(n):
+                    harness.cluster.create(
+                        "Service", fuzz._make_service(f"svc{i}", i, False)
+                    )
+                    yield 7200.0 / n
+
+            harness.spawn(creator(), "creator")
+            # mid-soak shard failover: kill one replica at hour 3 — the
+            # survivor steals its lease, adopts ~half the keyspace, and
+            # doubles its quota slice
+            harness.after(
+                3 * 3600.0, lambda: harness.kill_shard_replica(), "kill-replica"
+            )
+            harness.run_for(6 * 3600.0)
+            assert harness.run_until_quiescent(6 * 3600.0, settle_window=600.0), (
+                harness.stats()
+            )
+            violations = standard_oracles(harness)
+            assert violations == [], violations[:10]
+            assert len(harness.aws.all_accelerator_arns()) == n
+            ownership = harness.shard_ownership()
+            assert list(ownership.values()) == [frozenset({0, 1})], ownership
+            # both shards did real pre-failover work, and the soak
+            # crossed the failover: >= 2 stacks were ever built
+            assert harness.generations >= 2
+        wall = time.monotonic() - start_wall
+        assert wall < 900.0, f"50k two-shard soak took {wall:.0f}s (budget 900s)"
