@@ -55,6 +55,14 @@ stack — the classes ruff's pyflakes-tier cannot express:
   worst bug this codebase can ship — destroying a live cluster's
   resources with no event trail.
 
+- ``journey-stage-without-stamp`` — reconcile-loop paths that requeue,
+  park, or drop an item (``add_rate_limited``/``add_after``/``park``
+  in ``reconcile/reconcile.py``/``reconcile/pending.py``) must record
+  a journey stage (ISSUE 9): the convergence-latency SLO derives its
+  end-to-end measurement from these stamps, so an unstamped movement
+  is latency the /slo drill-down can never explain — exactly the slow
+  path the plane exists to surface.
+
 - ``cross-shard-sweep`` — GC sweeps and drift-tick enumeration paths
   (``controllers/garbagecollector.py``'s ``_sweep_*`` phases,
   ``manager.py``'s ``drift_tick``/``reshard_resync``, every
@@ -725,6 +733,78 @@ def check_cross_shard_sweep(
             "predicate (self._shards.owns(...) / shard_filter), or "
             "suppress with justification if this path is genuinely "
             "single-process",
+        )
+
+
+# ---------------------------------------------------------------------------
+# journey-stage-without-stamp
+# ---------------------------------------------------------------------------
+
+# the reconcile-loop item movements a journey must witness: requeues
+# (rate-limited or delayed) and parks.  ``forget``/``add`` alone are
+# bookkeeping; these three change an item's fate.
+_JOURNEY_MOVES = frozenset({"add_rate_limited", "add_after", "park"})
+_JOURNEYISH = re.compile(r"journey", re.IGNORECASE)
+# the queue implementation itself is mechanism (its internal re-adds
+# are not lifecycle decisions), and result.py holds no control flow
+_JOURNEY_EXEMPT_FILES = frozenset({"workqueue.py", "result.py", "__init__.py"})
+
+
+def _is_reconcile_loop_module(ctx: LintContext) -> bool:
+    return (
+        "reconcile" in ctx.path.parts
+        and ctx.path.name not in _JOURNEY_EXEMPT_FILES
+    )
+
+
+@rule(
+    "journey-stage-without-stamp",
+    "reconcile-loop paths that requeue, park, or drop an item must record "
+    "a journey stage — an unstamped movement makes the convergence-latency "
+    "SLO blind to exactly the slow paths it exists to measure",
+)
+def check_journey_stage_without_stamp(
+    tree: ast.Module, ctx: LintContext
+) -> Iterator[Violation]:
+    """The convergence SLO plane (ISSUE 9) derives end-to-end latency
+    from journey stamps.  Any function in the reconcile package
+    (``reconcile.py``/``pending.py`` — the loop and the pending-settle
+    scheduler; the workqueue is exempt mechanism) that moves an item
+    (``add_rate_limited``/``add_after``/``park``) without touching the
+    journey plane silently drops a lifecycle stage: latency keeps
+    accruing with no stage to explain it, and /slo's drill-down loses
+    the path."""
+    if not _is_reconcile_loop_module(ctx):
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        moves = [
+            node
+            for node in ast.walk(fn)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _JOURNEY_MOVES
+        ]
+        if not moves:
+            continue
+        stamps = any(
+            (isinstance(node, ast.Attribute) and _JOURNEYISH.search(node.attr))
+            or (isinstance(node, ast.Name) and _JOURNEYISH.search(node.id))
+            for node in ast.walk(fn)
+        )
+        if stamps:
+            continue
+        first = moves[0]
+        yield Violation(
+            "journey-stage-without-stamp",
+            str(ctx.path),
+            first.lineno,
+            f"{fn.name}() moves an item ({first.func.attr}) without "
+            "recording a journey stage — stamp it via "
+            "journey.tracker().stage(...) (or close it with "
+            "converged()/deleted()/drop()) so the convergence SLO sees "
+            "this path",
         )
 
 

@@ -179,6 +179,25 @@ def build_parser() -> argparse.ArgumentParser:
         "0 (default) disables tracing.",
     )
     controller.add_argument(
+        "--slo-eval-interval", type=float, default=15.0,
+        help="Seconds between convergence-SLO engine evaluations "
+        "(journey-latency burn rates over the 5m/1h windows; sustained "
+        "burn sheds GC sweeps and drift pacing before user-facing "
+        "convergence degrades further). The objectives and shed "
+        "doctrine are documented in docs/operations.md 'Convergence "
+        "SLOs'; /slo serves the live view. 0 disables the engine.",
+    )
+    controller.add_argument(
+        "--fleet-peers", default="",
+        help="Comma-separated host:port list of the OTHER shard "
+        "replicas' health endpoints. /metrics/fleet on this replica "
+        "then serves the fleet-merged view (counters and journey "
+        "histograms summed across replicas, gauges labeled by shard) "
+        "— the one scrape that answers fleet-wide convergence SLOs "
+        "under --shard-count > 1. Empty (default): the fleet view "
+        "carries only this replica.",
+    )
+    controller.add_argument(
         "--read-plane-ttl", type=float, default=None,
         help="Tick scope (seconds) of the coalesced verification read "
         "plane: accelerator-topology, record-set and load-balancer "
@@ -366,11 +385,42 @@ def run_controller(args) -> int:
     import threading
 
     from ..manager import make_health_server
+    from ..observability import fleet as obs_fleet
+    from ..observability import journey as obs_journey
+    from ..observability import slo as obs_slo
+
+    if args.slo_eval_interval > 0:
+        # the convergence SLO engine (ISSUE 9) over the process-global
+        # journey histograms; installing it globally arms the
+        # deferrable-load gates in the GC sweeper and drift tickers
+        slo_engine = obs_slo.SLOEngine(
+            registry=obs_metrics.registry(),
+            journey_tracker=obs_journey.tracker(),
+        )
+        obs_slo.install_engine(slo_engine)
+
+        def slo_loop():
+            while not stop.wait(args.slo_eval_interval):
+                try:
+                    slo_engine.tick()
+                except Exception as err:  # a bad tick must not kill the loop
+                    klog.errorf("slo engine tick failed: %s", err)
+
+        threading.Thread(target=slo_loop, daemon=True, name="slo-engine").start()
+
+    # the fleet-merged scrape (ISSUE 9): this replica's registry plus
+    # every --fleet-peers replica's /metrics
+    fleet_view = obs_fleet.FleetView({"self": obs_metrics.registry().render})
+    for peer in filter(None, (p.strip() for p in args.fleet_peers.split(","))):
+        url = peer if peer.startswith("http") else f"http://{peer}"
+        fleet_view.add_source(
+            peer, obs_fleet.http_fetcher(url.rstrip("/") + "/metrics")
+        )
 
     if args.health_port > 0:
         health_server = make_health_server(
             args.health_port, health=tracker, gc_status=manager.gc_status,
-            shard_status=manager.shard_status,
+            shard_status=manager.shard_status, fleet_view=fleet_view,
         )
         threading.Thread(
             target=health_server.serve_forever, daemon=True, name="health-server"
@@ -380,7 +430,7 @@ def run_controller(args) -> int:
         # probe and metrics networks; same handler, same registry
         metrics_server = make_health_server(
             args.metrics_port, health=tracker, gc_status=manager.gc_status,
-            shard_status=manager.shard_status,
+            shard_status=manager.shard_status, fleet_view=fleet_view,
         )
         threading.Thread(
             target=metrics_server.serve_forever, daemon=True, name="metrics-server"

@@ -19,6 +19,9 @@ from .. import apis, clockseam, klog
 from ..cloudprovider.aws import AWSDriver, get_lb_name_from_hostname
 from ..cloudprovider.aws.health import CircuitOpenError
 from ..cluster.informer import Tombstone
+from ..cluster.objects import meta_namespace_key
+from ..observability import journey as obs_journey
+from ..observability import slo as obs_slo
 from ..reconcile import RateLimitingQueue, Result, process_next_work_item
 
 # One driver per region; GA/Route53 are global services pinned to
@@ -64,6 +67,21 @@ def has_annotation(obj, annotation: str) -> bool:
 def annotation_changed(old, new, annotation: str) -> bool:
     return (annotation in old.metadata.annotations) != (
         annotation in new.metadata.annotations
+    )
+
+
+def stamp_journey_enqueued(
+    controller: str, obj: Any, trigger: str = obs_journey.TRIGGER_SPEC
+) -> None:
+    """The journey plane's opening stamp (ISSUE 9), from a
+    controller's enqueue path: keyed by the worker label the reconcile
+    loop will later close under, carrying the spec generation so a
+    newer edit restarts the latency clock."""
+    obs_journey.tracker().observe_enqueued(
+        controller,
+        meta_namespace_key(obj),
+        generation=getattr(obj.metadata, "generation", 0) or 0,
+        trigger=trigger,
     )
 
 
@@ -187,6 +205,14 @@ def start_drift_resync(
 
     def loop():
         while not stop.wait(period):
+            if obs_slo.should_shed("drift-resync"):
+                # burn-rate shedding (ISSUE 9): sustained convergence
+                # SLO burn defers drift verification — repair latency
+                # degrades before user-facing convergence does
+                klog.warningf(
+                    "drift resync %s: shed under SLO budget burn", name
+                )
+                continue
             for lister, predicate, enqueue in sources:
                 try:
                     for obj in lister.list():

@@ -42,6 +42,7 @@ from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
 from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
 from ..sharding import OWNS_ALL
+from ..observability import journey as obs_journey
 from .common import (
     CloudFactory,
     GLOBAL_REGION,
@@ -49,6 +50,7 @@ from .common import (
     lb_name_region_or_warn,
     make_sync_error_warner,
     run_workers,
+    stamp_journey_enqueued,
     start_drift_resync,
 )
 
@@ -119,20 +121,32 @@ class EndpointGroupBindingController:
         key = meta_namespace_key(obj)
         if not self._shards.owns_key(key):
             return  # another shard's replica reconciles this key
+        # the journey label is the WORKER name (what the reconcile
+        # loop closes under), not the queue's kind name
+        stamp_journey_enqueued(CONTROLLER_AGENT_NAME, obj)
         self.workqueue.add_rate_limited(key)
 
-    def drift_resync_sources(self) -> list:
+    def _resync_enqueue(self, obj, trigger: str) -> None:
+        """Drift/handoff re-enqueue: journey-stamped, then the plain
+        dedup add (the client-go resync pattern)."""
+        stamp_journey_enqueued(CONTROLLER_AGENT_NAME, obj, trigger=trigger)
+        self.workqueue.add(meta_namespace_key(obj))
+
+    def drift_resync_sources(
+        self, trigger: str = obs_journey.TRIGGER_DRIFT
+    ) -> list:
         """The canonical ``[(lister, predicate, enqueue), ...]`` drift
         re-enqueue wiring — consumed by the in-process ticker and by
         external single-tick drivers (the bench's drift-tick
-        measurement), so the two can never diverge."""
+        measurement), so the two can never diverge.  ``trigger``
+        labels the journeys these enqueues open."""
         # every EndpointGroupBinding is managed (no annotation gate);
         # the shard filter still partitions them across replicas
         return [
             (
                 self.binding_lister,
                 self._shards.owns_obj,
-                lambda b: self.workqueue.add(meta_namespace_key(b)),
+                lambda b: self._resync_enqueue(b, trigger),
             )
         ]
 

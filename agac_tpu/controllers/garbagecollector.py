@@ -55,6 +55,7 @@ from .. import klog
 from ..cloudprovider.aws.driver import OWNER_TAG_KEY, accelerator_owner_tag_value
 from ..errors import NotFoundError
 from ..observability import instruments, recorder
+from ..observability import slo as obs_slo
 from ..observability.metrics import MetricsRegistry
 from ..sharding import OWNS_ALL
 from ..sharding.reports import merge_shard_reports
@@ -219,6 +220,13 @@ class GarbageCollector:
         state mutations happen here, under the rails documented in the
         module docstring."""
         config = self._config
+        if obs_slo.should_shed("gc-sweep"):
+            # burn-rate shedding (ISSUE 9): while the convergence SLO
+            # budget burns, the sweeper is the FIRST deferrable load to
+            # go — orphans wait, user-facing convergence does not.  No
+            # grace state moves (a shed sweep is a non-observation).
+            klog.warningf("gc sweep: shed under SLO budget burn")
+            return {"shed": True, "shards": self._shards.token()}
         report = {
             # the shard-ownership token this partial sweep covered
             # ("all" in single-shard mode)

@@ -24,6 +24,7 @@ from ..cloudprovider import detect_cloud_provider
 from ..cluster import ClusterClient, EventRecorder, SharedInformerFactory
 from ..cluster.objects import split_meta_namespace_key, meta_namespace_key
 from ..errors import no_retry_errorf
+from ..observability import journey as obs_journey
 from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
 from ..sharding import OWNS_ALL
 from .common import (
@@ -35,6 +36,7 @@ from .common import (
     lb_name_region_or_warn,
     make_sync_error_warner,
     run_workers,
+    stamp_journey_enqueued,
     start_drift_resync,
     unwrap_tombstone,
     was_alb_ingress,
@@ -212,7 +214,15 @@ class GlobalAcceleratorController:
         key = meta_namespace_key(obj)
         if not self._shards.owns_key(key):
             return  # another shard's replica reconciles this key
+        stamp_journey_enqueued(queue.name, obj)
         queue.add_rate_limited(key)
+
+    def _resync_enqueue(self, queue: RateLimitingQueue, obj, trigger: str) -> None:
+        """Drift/handoff re-enqueue: journey-stamped with its trigger,
+        then the plain dedup add (NOT add_rate_limited — the client-go
+        resync pattern; see the run() comment)."""
+        stamp_journey_enqueued(queue.name, obj, trigger=trigger)
+        queue.add(meta_namespace_key(obj))
 
     # ------------------------------------------------------------------
     # run loop (reference ``controller.go:195-229``)
@@ -272,23 +282,26 @@ class GlobalAcceleratorController:
             ),
         ]
 
-    def drift_resync_sources(self) -> list:
+    def drift_resync_sources(
+        self, trigger: str = obs_journey.TRIGGER_DRIFT
+    ) -> list:
         """The canonical ``[(lister, predicate, enqueue), ...]`` drift
         re-enqueue wiring — consumed by the in-process ticker
         (``start_drift_resync``) and by external single-tick drivers
         (the bench's drift-tick measurement), so the two can never
-        diverge."""
+        diverge.  ``trigger`` labels the journeys these enqueues open
+        (drift ticks vs. the manager's shard-handoff resync)."""
         owns = self._shards.owns_obj  # shard-aware: foreign keys never tick
         return [
             (
                 self.service_lister,
                 lambda svc: is_managed_service(svc) and owns(svc),
-                lambda svc: self.service_queue.add(meta_namespace_key(svc)),
+                lambda svc: self._resync_enqueue(self.service_queue, svc, trigger),
             ),
             (
                 self.ingress_lister,
                 lambda ing: is_managed_ingress(ing) and owns(ing),
-                lambda ing: self.ingress_queue.add(meta_namespace_key(ing)),
+                lambda ing: self._resync_enqueue(self.ingress_queue, ing, trigger),
             ),
         ]
 

@@ -26,6 +26,7 @@ from ..cluster.objects import meta_namespace_key, split_meta_namespace_key
 from ..errors import no_retry_errorf
 from ..reconcile import RateLimitingQueue, Result, controller_rate_limiter
 from ..sharding import OWNS_ALL
+from ..observability import journey as obs_journey
 from .common import (
     CloudFactory,
     GLOBAL_REGION,
@@ -35,6 +36,7 @@ from .common import (
     lb_name_region_or_warn,
     make_sync_error_warner,
     run_workers,
+    stamp_journey_enqueued,
     start_drift_resync,
     unwrap_tombstone,
     was_load_balancer_service,
@@ -174,24 +176,34 @@ class Route53Controller:
         key = meta_namespace_key(obj)
         if not self._shards.owns_key(key):
             return  # another shard's replica reconciles this key
+        stamp_journey_enqueued(queue.name, obj)
         queue.add_rate_limited(key)
 
-    def drift_resync_sources(self) -> list:
+    def _resync_enqueue(self, queue: RateLimitingQueue, obj, trigger: str) -> None:
+        """Drift/handoff re-enqueue: journey-stamped, then the plain
+        dedup add (the client-go resync pattern)."""
+        stamp_journey_enqueued(queue.name, obj, trigger=trigger)
+        queue.add(meta_namespace_key(obj))
+
+    def drift_resync_sources(
+        self, trigger: str = obs_journey.TRIGGER_DRIFT
+    ) -> list:
         """The canonical ``[(lister, predicate, enqueue), ...]`` drift
         re-enqueue wiring — consumed by the in-process ticker and by
         external single-tick drivers (the bench's drift-tick
-        measurement), so the two can never diverge."""
+        measurement), so the two can never diverge.  ``trigger``
+        labels the journeys these enqueues open."""
         owns = self._shards.owns_obj  # shard-aware: foreign keys never tick
         return [
             (
                 self.service_lister,
                 lambda svc: is_hostname_managed_service(svc) and owns(svc),
-                lambda svc: self.service_queue.add(meta_namespace_key(svc)),
+                lambda svc: self._resync_enqueue(self.service_queue, svc, trigger),
             ),
             (
                 self.ingress_lister,
                 lambda ing: is_hostname_managed_ingress(ing) and owns(ing),
-                lambda ing: self.ingress_queue.add(meta_namespace_key(ing)),
+                lambda ing: self._resync_enqueue(self.ingress_queue, ing, trigger),
             ),
         ]
 

@@ -40,8 +40,11 @@ from typing import Callable, Optional
 from . import klog
 from .cloudprovider.aws import health as api_health
 from .cluster import ClusterClient, SharedInformerFactory
+from .observability import fleet as obs_fleet
+from .observability import journey as obs_journey
 from .observability import metrics as obs_metrics
 from .observability import recorder as obs_recorder
+from .observability import slo as obs_slo
 from .controllers import (
     EndpointGroupBindingConfig,
     EndpointGroupBindingController,
@@ -353,7 +356,12 @@ class Manager:
             self.on_reshard()
         enqueued = 0
         for controller in self.controllers.values():
-            for lister, predicate, enqueue in controller.drift_resync_sources():
+            # journeys opened by this resync are HANDOFF-triggered: the
+            # adopted keys' convergence latency is failover cost, not a
+            # spec edit's, and the SLO plane separates the two
+            for lister, predicate, enqueue in controller.drift_resync_sources(
+                trigger=obs_journey.TRIGGER_HANDOFF
+            ):
                 for obj in lister.list():
                     if predicate(obj):
                         enqueue(obj)
@@ -438,6 +446,18 @@ class Manager:
             "skipped": {},
             "partial": False,
         }
+        if obs_slo.should_shed("drift-tick"):
+            # burn-rate shedding (ISSUE 9): drift verification is
+            # deferrable — while the convergence budget burns, the
+            # tick is skipped and says so instead of adding load
+            report["shed"] = True
+            report["partial"] = True
+            self.last_drift_reports[report["shards"]] = report
+            obs_recorder.flight_recorder().record(
+                "drift-tick", shards=report["shards"], shed=True
+            )
+            klog.warningf("drift tick: shed under SLO budget burn")
+            return 0
         enqueued = 0
         for name, controller in self.controllers.items():
             open_services = (
@@ -538,6 +558,12 @@ class _HealthHandler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             self._metrics()
             return
+        if self.path == "/metrics/fleet":
+            self._fleet_metrics()
+            return
+        if self.path == "/slo":
+            self._slo()
+            return
         if self.path == "/debug/flightrecorder":
             self._flightrecorder()
             return
@@ -563,6 +589,10 @@ class _HealthHandler(BaseHTTPRequestHandler):
             # shard assignment (ISSUE 8): which shard leases this
             # replica holds, the observed map, and its quota slice
             "sharding": self.server.shard_status(),
+            # convergence SLO summary (ISSUE 9): burn rates + shed
+            # state — the block the rollout/federation gates read;
+            # the full view (objectives, slowest journeys) is /slo
+            "slo": self.server.slo_status(),
         }
         self._respond(500 if stuck else 200, body)
 
@@ -583,6 +613,26 @@ class _HealthHandler(BaseHTTPRequestHandler):
         """Prometheus text exposition of the wired registry (ISSUE 5):
         the scrape endpoint operators point their Prometheus at."""
         payload = self.server.metrics_registry.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", obs_metrics.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _slo(self):
+        """The convergence SLO plane in full (ISSUE 9): declared
+        objectives with burn rates and quantile estimates, shed state,
+        and the slowest unconverged journeys (each id greps straight
+        into /debug/flightrecorder)."""
+        self._respond(200, self.server.slo_status())
+
+    def _fleet_metrics(self):
+        """The fleet-merged exposition (ISSUE 9): this replica's
+        registry plus every configured peer's /metrics — counters and
+        journey histograms summed, gauges labeled by shard.  A peer
+        that fails to scrape is named in the leading meta comments,
+        never silently dropped."""
+        payload = self.server.fleet_view.render().encode()
         self.send_response(200)
         self.send_header("Content-Type", obs_metrics.CONTENT_TYPE)
         self.send_header("Content-Length", str(len(payload)))
@@ -621,22 +671,32 @@ def make_health_server(
     metrics_registry: Optional["obs_metrics.MetricsRegistry"] = None,
     flight_recorder: Optional["obs_recorder.FlightRecorder"] = None,
     shard_status: Optional[Callable[[], dict]] = None,
+    slo_status: Optional[Callable[[], dict]] = None,
+    fleet_view: Optional["obs_fleet.FleetView"] = None,
 ) -> ThreadingHTTPServer:
     """Build the manager's health endpoint (bind port 0 in tests);
     call ``serve_forever`` on a daemon thread to serve.  ``gc_status``
     is the manager's ``gc_status`` hook (defaults to disabled).
     ``/metrics`` renders ``metrics_registry`` (default: the
-    process-global registry, where the hot-path instruments land) and
+    process-global registry, where the hot-path instruments land),
     ``/debug/flightrecorder`` dumps ``flight_recorder`` (default: the
-    process-global ring)."""
+    process-global ring), ``/slo`` serves ``slo_status`` (default: the
+    installed global SLO engine, or a disabled stub), and
+    ``/metrics/fleet`` serves ``fleet_view`` (default: a one-source
+    view over this replica's own registry — ``--fleet-peers`` adds
+    the rest of the fleet)."""
     server = ThreadingHTTPServer((host, port), _HealthHandler)
     server.health_tracker = health
     server.heartbeats = heartbeats or api_health.worker_heartbeats()
     server.stuck_threshold = stuck_threshold
     server.gc_status = gc_status or (lambda: {"enabled": False})
     server.shard_status = shard_status or (lambda: {"enabled": False})
+    server.slo_status = slo_status or obs_slo.status_or_disabled
     server.metrics_registry = (
         metrics_registry if metrics_registry is not None else obs_metrics.registry()
+    )
+    server.fleet_view = fleet_view or obs_fleet.FleetView(
+        {"self": server.metrics_registry.render}
     )
     server.flight_recorder = (
         flight_recorder

@@ -1,9 +1,10 @@
-"""The observability plane (ISSUE 5): metrics, trace spans, and the
-flight recorder.
+"""The observability plane (ISSUE 5) + the convergence SLO plane
+(ISSUE 9): metrics, trace spans, the flight recorder, object journeys,
+SLO burn rates, and fleet-merged scrapes.
 
-Three dependency-free modules give the whole stack one telemetry
-surface (Arcturus' stability argument applied to *this* control plane:
-you cannot operate what you cannot measure):
+Dependency-free modules give the whole stack one telemetry surface
+(Arcturus' stability argument applied to *this* control plane: you
+cannot operate what you cannot measure):
 
 - ``metrics``: a thread-safe Prometheus-style registry
   (Counter/Gauge/Histogram with bounded label cardinality and text
@@ -14,15 +15,27 @@ you cannot operate what you cannot measure):
   structured log lines;
 - ``recorder``: a fixed-size ring buffer of recent reconcile
   outcomes/errors, dumpable via ``/debug/flightrecorder`` and on
-  SIGTERM — the post-mortem the logs have usually rotated away.
+  SIGTERM — the post-mortem the logs have usually rotated away;
+- ``journey``: per-object lifecycle stamps (enqueued → attempts →
+  parks → handoffs → converged) feeding the end-to-end
+  convergence-latency histograms — the only latency a *user* of the
+  controller experiences;
+- ``slo``: declared convergence objectives, multi-window error-budget
+  burn rates, and the burn-gated shedding of deferrable load (GC
+  sweeps, drift pacing) — served on ``/slo``;
+- ``fleet``: merges shard replicas' scrapes (counters summed, gauges
+  shard-labeled, journey histograms aggregated) into the one fleet
+  view ``/metrics/fleet`` serves.
 
 ``instruments`` centralizes every metric declaration so the exposed
 catalog (``python -m agac_tpu.observability.catalog``) can never drift
 from the instrumented code.
 """
 
+from .journey import JourneyTracker
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
 from .recorder import FlightRecorder, flight_recorder
+from .slo import SLOEngine, SLOObjective
 from .trace import Span, Trace, Tracer, tracer
 
 __all__ = [
@@ -33,6 +46,9 @@ __all__ = [
     "registry",
     "FlightRecorder",
     "flight_recorder",
+    "JourneyTracker",
+    "SLOEngine",
+    "SLOObjective",
     "Span",
     "Trace",
     "Tracer",

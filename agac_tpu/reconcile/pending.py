@@ -48,7 +48,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .. import clockseam, klog
-from ..observability import instruments
+from ..observability import instruments, journey
 
 # what a group poller reports per token
 SETTLE_PENDING = "pending"
@@ -100,6 +100,9 @@ class _Parked:
     token: object
     parked_at: float
     deadline: float
+    # the journey plane's controller label (the parking reconcile
+    # loop's worker label; falls back to the queue name when unset)
+    controller: str = ""
 
 
 @dataclass
@@ -146,7 +149,7 @@ class PendingSettleTable:
         with self._lock:
             self._groups.setdefault(group, _GroupState()).poller = poller
 
-    def park(self, key: str, queue, wait: SettleWait) -> None:
+    def park(self, key: str, queue, wait: SettleWait, controller: str = "") -> None:
         """Park ``key`` until ``wait`` resolves (or its deadline
         expires).  A key re-parked in the same group replaces its
         entry (fresh token + deadline); parking the same key under a
@@ -160,6 +163,7 @@ class PendingSettleTable:
             token=wait.token,
             parked_at=now,
             deadline=now + max(wait.timeout, 0.001),
+            controller=controller,
         )
         with self._lock:
             for state in self._groups.values():
@@ -213,7 +217,8 @@ class PendingSettleTable:
                     report["expired"] += 1
                     # expiry is failure-shaped: the wait never resolved,
                     # so the retry backs off like any failing item
-                    self._requeue(entry, failed=True)
+                    self._requeue(entry, failed=True,
+                                  stage=journey.STAGE_SETTLE_EXPIRED)
                 else:
                     live.append(entry)
             if not live:
@@ -247,13 +252,15 @@ class PendingSettleTable:
                     self.resolved_total += 1
                     report["resolved"] += 1
                     self._m_resolved.labels(group=name, outcome="ready").inc()
-                    self._requeue(entry, failed=False)
+                    self._requeue(entry, failed=False,
+                                  stage=journey.STAGE_SETTLE_RESOLVED)
                 elif outcome == SETTLE_FAILED:
                     self._remove(entry)
                     self.failed_total += 1
                     report["failed"] += 1
                     self._m_resolved.labels(group=name, outcome="failed").inc()
-                    self._requeue(entry, failed=True)
+                    self._requeue(entry, failed=True,
+                                  stage=journey.STAGE_SETTLE_FAILED)
                 else:
                     report["pending"] += 1
         return report
@@ -265,7 +272,17 @@ class PendingSettleTable:
                 del state.entries[entry.key]
 
     @staticmethod
-    def _requeue(entry: _Parked, failed: bool) -> None:
+    def _requeue(entry: _Parked, failed: bool, stage: str) -> None:
+        # the journey stamp (ISSUE 9): the settle wait's outcome is a
+        # lifecycle stage; queue names are the controller labels the
+        # journey plane keys on
+        journey.tracker().stage(
+            entry.controller
+            or getattr(entry.queue, "name", "")
+            or entry.group,
+            entry.key,
+            stage,
+        )
         try:
             if failed:
                 entry.queue.add_rate_limited(entry.key)

@@ -31,7 +31,7 @@ from typing import Any, Callable
 from .. import clockseam, klog
 from ..cloudprovider.aws import health as api_health
 from ..errors import NoRetryError, NotFoundError, is_no_retry
-from ..observability import instruments, recorder, trace
+from ..observability import instruments, journey, recorder, trace
 from .pending import SettleWait
 from .result import Result
 from .workqueue import RateLimitingQueue
@@ -163,10 +163,18 @@ def _reconcile_handler(
         queue.forget(key)
         klog.errorf("expected string in workqueue but got %r", key)
         return
+    controller = _controller_name()
+    # the journey plane (ISSUE 9): stamp the attempt, and capture the
+    # journey's id BEFORE the result branches below can close it — the
+    # flight-recorder entry must carry the id either way, so a slow
+    # convergence in /slo is one grep away from its recorded attempts
+    journeys = journey.tracker()
+    journeys.attempt(controller, key)
+    journey_id = journeys.journey_id(controller, key)
     start = clockseam.monotonic()
     try:
         with trace.span("sync"):
-            res, err = _dispatch(
+            res, err, was_delete = _dispatch(
                 key, key_to_obj, process_delete, process_create_or_update
             )
     finally:
@@ -175,7 +183,6 @@ def _reconcile_handler(
     if _sync_duration_observers:
         _observe_sync_duration(key, elapsed, err)
 
-    controller = _controller_name()
     reconcile_metrics = instruments.reconcile_instruments()
     reconcile_metrics.duration.labels(controller=controller).observe(elapsed)
 
@@ -187,7 +194,8 @@ def _reconcile_handler(
         # a failure: backoff state is untouched, and the sync-result
         # hook sees a clean pass so failure streaks reset.
         result = instruments.RESULT_PARKED
-        err.table.park(key, queue, err)
+        err.table.park(key, queue, err, controller=controller)
+        journeys.stage(controller, key, journey.STAGE_PARKED)
         klog.v(2).infof("Parked %r: %s", key, err)
         _notify(on_sync_result, key, None, 0, False)
         err = None
@@ -195,10 +203,14 @@ def _reconcile_handler(
         permanent = is_no_retry(err)
         if permanent:
             result = instruments.RESULT_PERMANENT_ERROR
+            # the item will NOT be retried: its journey can never
+            # converge, so drop it (the stage counter still shows it)
+            journeys.drop(controller, key)
             klog.errorf("error syncing %r: %s", key, err)
         else:
             result = instruments.RESULT_ERROR
             queue.add_rate_limited(key)
+            journeys.stage(controller, key, journey.STAGE_REQUEUED)
             klog.errorf("error syncing %r, and requeued: %s", key, err)
         if isinstance(err, api_health.DeadlineExceeded):
             reconcile_metrics.deadline_exceeded.labels(controller=controller).inc()
@@ -207,16 +219,25 @@ def _reconcile_handler(
         result = instruments.RESULT_REQUEUE_AFTER
         queue.forget(key)
         queue.add_after(key, res.requeue_after)
+        journeys.stage(controller, key, journey.STAGE_REQUEUED)
         klog.infof("Successfully synced %r, but requeued after %.1fs", key, res.requeue_after)
         _notify(on_sync_result, key, None, 0, False)
     elif res.requeue:
         result = instruments.RESULT_REQUEUE
         queue.add_rate_limited(key)
+        journeys.stage(controller, key, journey.STAGE_REQUEUED)
         klog.infof("Successfully synced %r, but requeued", key)
         _notify(on_sync_result, key, None, 0, False)
     else:
         result = instruments.RESULT_SUCCESS
         queue.forget(key)
+        # a clean terminal pass closes the journey: the object's spec
+        # is verified converged (or its teardown finished) — this is
+        # the observation the convergence-latency histogram measures
+        if was_delete:
+            journeys.deleted(controller, key)
+        else:
+            journeys.converged(controller, key)
         klog.infof("Successfully synced %r", key)
         _notify(on_sync_result, key, None, 0, False)
 
@@ -233,6 +254,7 @@ def _reconcile_handler(
         result=result,
         duration=round(elapsed, 4),
         error=str(err) if err is not None else "",
+        journey=journey_id or "",
     )
 
 
@@ -250,23 +272,30 @@ def _dispatch(
     key_to_obj: KeyToObjFunc,
     process_delete: ProcessDeleteFunc,
     process_create_or_update: ProcessCreateOrUpdateFunc,
-) -> tuple[Result, Exception | None]:
+) -> tuple[Result, Exception | None, bool]:
+    """(result, error, was_delete) — the delete-path flag lets the
+    journey plane close a finished teardown as ``deleted`` rather than
+    ``converged``."""
     try:
         obj = key_to_obj(key)
     except NotFoundError:
         try:
-            return process_delete(key), None
+            return process_delete(key), None, True
         except Exception as err:
-            return Result(), err
+            return Result(), err, True
     except Exception as err:
         # A store read failing for any reason other than NotFound is
         # logged without a requeue in the reference
         # (``reconcile.go:64-65`` returns before the retry policy);
         # NoRetryError reproduces exactly that.
-        return Result(), NoRetryError(f"Unable to retrieve {key!r} from store: {err}")
+        return (
+            Result(),
+            NoRetryError(f"Unable to retrieve {key!r} from store: {err}"),
+            False,
+        )
     try:
         # DeepCopy before mutation: the cache/lister owns ``obj``
         # (reference ``pkg/reconcile/reconcile.go:67``).
-        return process_create_or_update(copy.deepcopy(obj)), None
+        return process_create_or_update(copy.deepcopy(obj)), None, False
     except Exception as err:
-        return Result(), err
+        return Result(), err, False

@@ -48,7 +48,12 @@ from .. import apis, klog
 from ..analysis import racecheck
 from ..cloudprovider.aws.health import GA_OPS, ROUTE53_OPS, HealthConfig
 from .harness import SimHarness, SimHarnessConfig
-from .oracles import CircuitBudgetOracle, GCDeletionOracle, standard_oracles
+from .oracles import (
+    CircuitBudgetOracle,
+    GCDeletionOracle,
+    check_slo,
+    standard_oracles,
+)
 
 # ops the brownout composition can black out, grouped by service
 _SERVICE_OPS = {
@@ -71,7 +76,7 @@ _CRASHABLE_OPS = [
     "change_resource_record_sets",
 ]
 
-CANARIES = ("drop-txt-delete", "gc-stale-owner-cache")
+CANARIES = ("drop-txt-delete", "gc-stale-owner-cache", "slo-brownout")
 
 
 @dataclass
@@ -167,6 +172,25 @@ def _install_canary(harness: SimHarness, canary: str) -> None:
                 gc._owner_exists = lambda resource, ns, name: False
 
         harness.on_stack_built = break_owner_check
+    elif canary == "slo-brownout":
+        # the SLO oracle's mutation test (ISSUE 9): a sustained GA
+        # outage far longer than the convergence objective — journeys
+        # opened during it converge only after restore, burning the
+        # error budget.  The oracle must flag the objectives AND the
+        # burn-gated shedding (gates armed here, observe-only
+        # elsewhere) must be observed deferring GC/drift work.
+        harness.slo_engine.shed_gates = True
+        ops = sorted(GA_OPS)
+        harness.after(
+            60.0,
+            lambda: harness.fault_plan.outage(*ops),
+            "canary:slo-brownout",
+        )
+        harness.after(
+            660.0,
+            lambda: harness.fault_plan.restore(*ops),
+            "canary:slo-brownout-end",
+        )
     else:
         raise ValueError(f"unknown canary {canary!r} (have {CANARIES})")
 
@@ -200,9 +224,19 @@ def run_scenario(
     seed: int,
     profile: str = "quick",
     canary: Optional[str] = None,
+    no_faults: bool = False,
 ) -> ScenarioResult:
     """Play one fully seeded scenario; returns the oracle verdicts and
-    the replayable trace hash."""
+    the replayable trace hash.
+
+    ``no_faults`` drops every fault composition (and the chaos
+    budget), keeping only the churn stream — the configuration under
+    which the convergence-SLO oracle is ARMED: a fault-free run that
+    misses an objective is a real regression, while fault-injected
+    runs carry their SLO report in ``stats`` without failing on it
+    (blowing the tail under a brownout is what the error budget is
+    for; the ``slo-brownout`` canary proves the oracle catches when
+    it must)."""
     shape = PROFILES[profile]
     rng = random.Random(seed)
     config = SimHarnessConfig(
@@ -234,7 +268,7 @@ def run_scenario(
             gc_oracle = GCDeletionOracle(config.cluster_name).attach(harness)
             harness.run_for(15.0)  # leadership + initial sync
             gc_oracle.prime()
-            if shape.chaos_budget:
+            if shape.chaos_budget and not no_faults:
                 harness.fault_plan.chaos(
                     rng.randrange(1 << 30), shape.chaos_budget, p=0.08,
                     ambiguous=0.3,
@@ -244,7 +278,8 @@ def run_scenario(
             harness.spawn(
                 _churn_actor(harness, rng, shape), "churn"
             )
-            _schedule_faults(harness, rng, shape, circuit_oracles)
+            if not no_faults:
+                _schedule_faults(harness, rng, shape, circuit_oracles)
 
             harness.run_for(shape.active_seconds)
             # lift standing faults (outages + chaos); any scripted
@@ -265,17 +300,31 @@ def run_scenario(
             violations += gc_oracle.violations
             for oracle in circuit_oracles:
                 violations += oracle.violations
+            # the convergence-SLO oracle (ISSUE 9): armed for
+            # fault-free runs and for the canary built to trip it;
+            # fault-injected runs carry the report in stats only
+            harness.slo_engine.tick()  # final window advance
+            slo_violations = check_slo(harness)
+            if no_faults or canary == "slo-brownout":
+                violations += slo_violations
             try:
                 watchdog.assert_clean()
             except AssertionError as err:
                 violations.append(f"racecheck: {err}")
+            stats = harness.stats()
+            stats["slo"] = {
+                "violations": slo_violations,
+                "shedding": harness.slo_engine.shedding,
+                "shed_activations": harness.slo_engine.shed_activations,
+                "journeys": harness.journey.stats(),
+            }
             return ScenarioResult(
                 seed=seed,
                 profile=profile,
                 canary=canary,
                 trace_hash=harness.trace_hash(),
                 violations=violations,
-                stats=harness.stats(),
+                stats=stats,
                 trace_tail=list(harness.scheduler.trace_tail)[-200:],
             )
     finally:
@@ -468,12 +517,21 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--seeds", default="1,2,3,4,5")
     parser.add_argument("--profile", default="quick", choices=sorted(PROFILES))
     parser.add_argument("--canary", default=None, choices=CANARIES)
+    parser.add_argument(
+        "--no-faults", action="store_true",
+        help="churn only, no fault compositions — ARMS the "
+        "convergence-SLO oracle (a fault-free run missing an "
+        "objective is a regression)",
+    )
     parser.add_argument("--artifacts", default=None)
     args = parser.parse_args(argv)
 
     failures = 0
     for seed in [int(s) for s in args.seeds.split(",") if s]:
-        result = run_scenario(seed, profile=args.profile, canary=args.canary)
+        result = run_scenario(
+            seed, profile=args.profile, canary=args.canary,
+            no_faults=args.no_faults,
+        )
         status = "ok" if result.ok else "FAIL"
         print(
             f"seed {seed} [{args.profile}] {status} "

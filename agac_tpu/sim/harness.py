@@ -73,6 +73,10 @@ from ..controllers.common import with_circuit_backoff
 from ..controllers.garbagecollector import GarbageCollectorConfig
 from ..leaderelection import LeaderElection, LeaderElectionConfig
 from ..manager import ControllerConfig, Manager
+from ..observability import fleet as obs_fleet
+from ..observability import journey as obs_journey
+from ..observability import metrics as obs_metrics
+from ..observability import slo as obs_slo
 from ..reconcile.pending import PendingSettleTable
 from ..reconcile.reconcile import process_next_work_item
 from ..sharding import ShardingConfig
@@ -135,6 +139,13 @@ class SimHarnessConfig:
     # fake-backend shape when the harness builds it
     quota_accelerators: int = 200
     settle_describes: int = 2
+    # the convergence SLO plane (ISSUE 9): evaluation cadence of the
+    # per-scenario engine (0 disables journey/SLO tracking entirely);
+    # shed gates default OFF in sim — the burn state machine and its
+    # metrics run either way, but only a scenario that opts in has
+    # sustained burn actually defer GC sweeps / drift ticks
+    slo_eval_interval: float = 15.0
+    slo_shed_gates: bool = False
 
 
 class _World:
@@ -151,16 +162,26 @@ class _World:
         config = harness.config
         scheduler = harness.scheduler
         self._harness = harness
+        # one PRIVATE metrics registry per process-world (ISSUE 9):
+        # concurrently-live sim replicas must never fold their
+        # counters/gauges into one process-global registry — two
+        # replicas' agac_shard_keys_owned summed into one series is
+        # exactly the cross-process telepathy the fleet-merge layer
+        # exists to do explicitly (and label by shard)
+        self.registry = obs_metrics.MetricsRegistry()
         self.health = (
             HealthTracker(
                 config=config.health,
                 clock=scheduler.monotonic,
                 sleep=scheduler.clock.sleep,
+                registry=self.registry,
             )
             if config.health is not None
             else None
         )
-        self.settle_table = PendingSettleTable(clock=scheduler.monotonic)
+        self.settle_table = PendingSettleTable(
+            clock=scheduler.monotonic, registry=self.registry
+        )
         self.batcher = (
             ChangeBatcher(
                 max_changes=config.r53_batch_max,
@@ -267,7 +288,9 @@ class _Stack:
             else harness.controller_config
         )
         self.manager = Manager(
-            resync_period=harness.config.resync_period, health=self.world.health
+            resync_period=harness.config.resync_period,
+            health=self.world.health,
+            metrics_registry=self.world.registry,
         )
         self.informer_factory = SharedInformerFactory(
             harness.cluster,
@@ -529,6 +552,31 @@ class SimHarness:
             self.aws.install_fault_plan(FaultPlan(exempt_creator=False))
         self.fault_plan = self.aws.fault_plan
 
+        # the convergence SLO plane (ISSUE 9): one fleet-scoped journey
+        # tracker + SLO engine per scenario, on virtual time, installed
+        # over the process globals for the harness's lifetime (the
+        # reconcile loop and the controllers' enqueue stamps read the
+        # global seam) and restored on exit.  Journeys are fleet-wide
+        # by design: a key's journey survives the replica that opened
+        # it, so a failover's true end-to-end latency is measured.
+        self.journey_registry = obs_metrics.MetricsRegistry()
+        self.journey = obs_journey.JourneyTracker(
+            registry=self.journey_registry, clock=self.scheduler.monotonic
+        )
+        self._prev_journey = obs_journey.install(self.journey)
+        self.slo_engine = obs_slo.SLOEngine(
+            registry=self.journey_registry,
+            clock=self.scheduler.monotonic,
+            journey_tracker=self.journey,
+            shed_gates=config.slo_shed_gates,
+        )
+        self._prev_slo = obs_slo.install_engine(self.slo_engine)
+        if config.slo_eval_interval > 0:
+            self.scheduler.every(
+                config.slo_eval_interval, self.slo_engine.tick, "slo-eval",
+                priority=1,
+            )
+
         if self._sharded:
             # every replica gets its OWN process-world when it is
             # built (add_shard_replica below); the harness-level
@@ -608,6 +656,8 @@ class SimHarness:
         from .. import clockseam
 
         self._installed = False
+        obs_journey.install(self._prev_journey)
+        obs_slo.install_engine(self._prev_slo)
         clockseam.reset()
 
     # ------------------------------------------------------------------
@@ -1003,6 +1053,16 @@ class SimHarness:
 
     def trace_hash(self) -> str:
         return self.scheduler.trace_hash()
+
+    def fleet_metrics(self) -> str:
+        """The fleet-merged exposition over every LIVE replica's
+        private world registry plus the scenario's journey registry —
+        the in-sim analog of scraping every replica's /metrics/fleet
+        (counters/histograms summed, gauges labeled by shard)."""
+        sources = {"journeys": self.journey_registry.render}
+        for stack in self.live_stacks():
+            sources[stack.identity] = stack.world.registry.render
+        return obs_fleet.FleetView(sources).render()
 
     def stats(self) -> dict:
         stats = {

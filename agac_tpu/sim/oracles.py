@@ -210,6 +210,20 @@ def check_exclusive_shard_ownership(harness) -> list[str]:
     return violations
 
 
+def check_slo(harness) -> list[str]:
+    """The convergence-SLO oracle (ISSUE 9): every declared objective's
+    CUMULATIVE good fraction over the whole scenario meets its target.
+    NOT part of ``standard_oracles`` — fault-injected scenarios
+    legitimately blow convergence tails (that is what the budget is
+    for), so callers arm this only for fault-free runs, for soaks
+    whose faults the objectives are expected to absorb, and for the
+    ``slo-brownout`` canary that proves the oracle can catch."""
+    engine = getattr(harness, "slo_engine", None)
+    if engine is None:
+        return ["slo: harness has no SLO engine (slo_eval_interval 0?)"]
+    return engine.violations()
+
+
 def standard_oracles(harness, cluster_name: str = "default") -> list[str]:
     """The full final-state battery."""
     violations = (

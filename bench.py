@@ -71,6 +71,8 @@ import time
 import urllib.request
 
 from agac_tpu import klog
+from agac_tpu.observability import fleet as obs_fleet
+from agac_tpu.observability import journey as obs_journey
 from agac_tpu.observability import metrics as obs_metrics
 from agac_tpu.cloudprovider.aws.cache import (
     AcceleratorTopologyCache,
@@ -780,6 +782,16 @@ def run_convergence(
         with lat_lock:
             latencies.setdefault(label, []).append(seconds)
 
+    # the convergence SLO plane (ISSUE 9): a PER-PHASE journey tracker
+    # (private registry) so the baseline's latencies never bleed into
+    # the tuned phase's percentiles; the phase's convergence block is
+    # read back through the fleet-merge layer — the same read the
+    # sharded fleet view uses
+    journey_registry = obs_metrics.MetricsRegistry()
+    previous_tracker = obs_journey.install(
+        obs_journey.JourneyTracker(registry=journey_registry)
+    )
+
     stop = threading.Event()
     config = ControllerConfig(
         global_accelerator=GlobalAcceleratorConfig(
@@ -888,6 +900,7 @@ def run_convergence(
             }
     finally:
         remove_sync_duration_observer(observer)
+        obs_journey.install(previous_tracker)
         stop.set()
 
     with lat_lock:
@@ -931,6 +944,12 @@ def run_convergence(
         ),
         "throttled_acquisitions": throttled,
         "sync_latency": sync_latency,
+        # end-to-end object-journey convergence latency per kind
+        # (ISSUE 9), read through the fleet-merge layer off this
+        # phase's journey histograms
+        "convergence": obs_fleet.converge_percentiles(
+            obs_fleet.merge_expositions({"self": journey_registry.render()})[0]
+        ),
     }
     cache_stats = plane.stats()
     if cache_stats:
@@ -1268,14 +1287,15 @@ def _scrape_shard_process(port: int) -> dict:
     with urllib.request.urlopen(
         f"http://127.0.0.1:{port}/metrics", timeout=5
     ) as response:
-        for line in response.read().decode().splitlines():
-            if line.startswith("agac_aws_api_calls_total{"):
-                labels, value = line.rsplit(" ", 1)
-                service = labels.split('service="')[1].split('"')[0]
-                # elbv2[region] folds into elbv2: the budget is per
-                # service family here
-                service = service.split("[", 1)[0]
-                calls[service] = calls.get(service, 0.0) + float(value)
+        metrics_text = response.read().decode()
+    for line in metrics_text.splitlines():
+        if line.startswith("agac_aws_api_calls_total{"):
+            labels, value = line.rsplit(" ", 1)
+            service = labels.split('service="')[1].split('"')[0]
+            # elbv2[region] folds into elbv2: the budget is per
+            # service family here
+            service = service.split("[", 1)[0]
+            calls[service] = calls.get(service, 0.0) + float(value)
     ceilings: dict[str, float] = {}
     try:
         with urllib.request.urlopen(
@@ -1292,7 +1312,12 @@ def _scrape_shard_process(port: int) -> dict:
         f"http://127.0.0.1:{port}/healthz", timeout=5
     ) as response:
         sharding = json.loads(response.read())["sharding"]
-    return {"calls": calls, "ceilings": ceilings, "sharding": sharding}
+    return {
+        "calls": calls,
+        "ceilings": ceilings,
+        "sharding": sharding,
+        "metrics_text": metrics_text,
+    }
 
 
 def _run_shard_fleet(shard_count: int, replicas: int, n: int) -> dict:
@@ -1422,12 +1447,23 @@ def _run_shard_fleet(shard_count: int, replicas: int, n: int) -> dict:
     for replica in per_replica:
         for service, count in replica["calls"].items():
             calls_by_service[service] = calls_by_service.get(service, 0.0) + count
+    # the fleet-merged convergence view (ISSUE 9): every replica's
+    # journey histograms summed through the fleet-merge layer — the
+    # ONLY correct way to state a fleet-wide p99 (averaging per-shard
+    # percentiles would be statistically meaningless)
+    fleet_families, _ = obs_fleet.merge_expositions(
+        {
+            f"replica-{i}": replica["metrics_text"]
+            for i, replica in enumerate(per_replica)
+        }
+    )
     return {
         "shard_count": shard_count,
         "replicas": replicas,
         "n_objects": n,
         "elapsed_s": round(elapsed, 2),
         "objects_per_sec": round(n / elapsed, 2),
+        "convergence": obs_fleet.converge_percentiles(fleet_families),
         "aws_calls_by_service": {k: int(v) for k, v in sorted(calls_by_service.items())},
         "aggregate_calls_per_sec_by_service": {
             service: round(count / elapsed, 2)
@@ -1439,6 +1475,15 @@ def _run_shard_fleet(shard_count: int, replicas: int, n: int) -> dict:
                 "quota_fraction": replica["sharding"].get("quota_fraction"),
                 "aimd_ceilings": replica["ceilings"],
                 "aws_calls": {k: int(v) for k, v in sorted(replica["calls"].items())},
+                # per-replica journey totals, so the fleet-merged
+                # count is checkable against its parts
+                "journey_converged": int(
+                    obs_fleet.converge_percentiles(
+                        obs_fleet.merge_expositions(
+                            {"self": replica["metrics_text"]}
+                        )[0]
+                    )["ga"]["count"]
+                ),
             }
             for replica in per_replica
         ],
@@ -1667,6 +1712,16 @@ def main():
         "sharding": {
             "speedup": sharding["speedup"],
             "agg_objs_per_sec": sharding["sharded"]["objects_per_sec"],
+        },
+        # fleet-merged convergence SLO signals (ISSUE 9): per-kind
+        # journey p99 of the tuned phase (through the fleet-merge
+        # read) + the 2-replica fleet-merged GA p99 of the sharded run
+        "convergence": {
+            "ga_p99_s": tuned["convergence"]["ga"]["p99_s"],
+            "record_p99_s": tuned["convergence"]["record"]["p99_s"],
+            "fleet_sharded_ga_p99_s": sharding["sharded"]["convergence"]["ga"][
+                "p99_s"
+            ],
         },
         "detail_file": os.path.basename(DETAIL_PATH),
     }

@@ -968,6 +968,105 @@ class TestCrossShardSweep:
         assert violations, "suppression without justification must not hold"
 
 
+class TestJourneyStageWithoutStamp:
+    """The convergence SLO plane's stamp gate (ISSUE 9): a reconcile
+    path that requeues/parks/drops without a journey stamp is latency
+    the /slo drill-down can never explain."""
+
+    def test_unstamped_requeue_fires_once(self):
+        v = only(
+            run(
+                """
+                def _handle(key, queue):
+                    queue.add_rate_limited(key)
+                """,
+                path="agac_tpu/reconcile/loop.py",
+            ),
+            "journey-stage-without-stamp",
+        )
+        assert "add_rate_limited" in v.message and "journey" in v.message
+
+    def test_unstamped_park_fires_once(self):
+        v = only(
+            run(
+                """
+                def _handle(key, queue, table, wait):
+                    table.park(key, queue, wait)
+                """,
+                path="agac_tpu/reconcile/loop.py",
+            ),
+            "journey-stage-without-stamp",
+        )
+        assert "park" in v.message
+
+    def test_stamped_requeue_is_clean(self):
+        assert (
+            run(
+                """
+                from ..observability import journey
+
+                def _handle(key, queue):
+                    journey.tracker().stage("ctrl", key, "requeued")
+                    queue.add_rate_limited(key)
+                """,
+                path="agac_tpu/reconcile/loop.py",
+            )
+            == []
+        )
+
+    def test_journey_close_counts_as_a_stamp(self):
+        assert (
+            run(
+                """
+                def _expire(entry, journeys):
+                    journeys.drop("ctrl", entry.key)
+                    entry.queue.add_after(entry.key, 5.0)
+                """,
+                path="agac_tpu/reconcile/pending_extra.py",
+            )
+            == []
+        )
+
+    def test_workqueue_mechanism_is_exempt(self):
+        # the queue implementation's internal re-adds are mechanism,
+        # not lifecycle decisions
+        assert (
+            run(
+                """
+                def requeue_internal(self, item):
+                    self.add_rate_limited(item)
+                """,
+                path="agac_tpu/reconcile/workqueue.py",
+            )
+            == []
+        )
+
+    def test_rule_is_scoped_to_the_reconcile_package(self):
+        # controllers' enqueue paths carry their own stamps; the rule
+        # polices the loop package where the retry policy lives
+        assert (
+            run(
+                """
+                def _enqueue(self, queue, obj):
+                    queue.add_rate_limited(key(obj))
+                """,
+                path="agac_tpu/controllers/somecontroller.py",
+            )
+            == []
+        )
+
+    def test_suppression_needs_justification(self):
+        src = """
+        def _handle(key, queue):
+            queue.add_rate_limited(key)  # agac-lint: ignore[journey-stage-without-stamp] -- test-only shim queue
+        """
+        assert run(src, path="agac_tpu/reconcile/loop.py") == []
+        bare = src.replace(" -- test-only shim queue", "")
+        assert run(bare, path="agac_tpu/reconcile/loop.py"), (
+            "suppression without justification must not hold"
+        )
+
+
 def test_rule_registry_ships_the_documented_rules():
     ids = {r.id for r in RULES}
     assert ids == {
@@ -983,6 +1082,7 @@ def test_rule_registry_ships_the_documented_rules():
         "unregistered-metric",
         "unseamed-clock",
         "cross-shard-sweep",
+        "journey-stage-without-stamp",
     }
 
 

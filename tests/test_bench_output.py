@@ -83,6 +83,13 @@ def test_last_stdout_line_is_compact_parseable_headline(bench_run):
         "r53_cr_calls",
     ):
         assert key in headline
+    # the convergence SLO signals (ISSUE 9): per-kind fleet-merged
+    # journey p99s ride the headline
+    convergence = headline["convergence"]
+    for key in ("ga_p99_s", "record_p99_s", "fleet_sharded_ga_p99_s"):
+        assert key in convergence, f"headline convergence missing {key!r}"
+    assert convergence["ga_p99_s"] > 0
+    assert convergence["record_p99_s"] > 0
     assert headline["detail_file"] == "bench_detail.json"
 
 
@@ -138,6 +145,18 @@ def test_detail_artifact_written_and_complete(bench_run, detail_path):
     assert batching["submissions"] >= 1
     # batching can never INCREASE the wire-call count
     assert batching["wire_calls"] <= batching["submissions"]
+    # the convergence block (ISSUE 9): per-kind journey p50/p99 off the
+    # phase's journey histograms, per phase — every kind measured, and
+    # every journey the tuned phase opened converged
+    for phase in ("baseline", "tuned"):
+        convergence = detail[phase]["convergence"]
+        for kind in ("ga", "record", "binding"):
+            assert convergence[kind]["count"] > 0, f"{phase}: no {kind} journeys"
+            assert convergence[kind]["p99_s"] >= convergence[kind]["p50_s"] >= 0
+    tuned_conv = detail["tuned"]["convergence"]
+    # every Service+Ingress journey of the tuned phase closed (churn
+    # may add binding reopenings, so >= on the ga side)
+    assert tuned_conv["ga"]["count"] >= detail["tuned"]["n_services"]
 
 
 def test_sharding_block_exported_and_quota_respected(bench_run, detail_path):
@@ -181,10 +200,20 @@ def test_sharding_block_exported_and_quota_respected(bench_run, detail_path):
     owned = [set(replica["owned_shards"]) for replica in sharded["per_replica"]]
     assert owned[0] & owned[1] == set(), owned
     assert set().union(*owned) == {0, 1}
+    # the fleet-merged convergence view (ISSUE 9): the merged journey
+    # count equals the SUM of the replicas' counts (histograms sum,
+    # nothing lost, nothing double-counted), and covers the fleet
+    merged = sharded["convergence"]["ga"]
+    assert merged["count"] == sum(
+        replica["journey_converged"] for replica in sharded["per_replica"]
+    )
+    assert merged["count"] >= sharded["n_objects"]
+    assert merged["p99_s"] > 0
     # the headline carries the scale-out summary
     lines = [ln for ln in bench_run.stdout.splitlines() if ln.strip()]
     headline = json.loads(lines[-1])
     assert headline["sharding"]["speedup"] == sharding["speedup"]
+    assert headline["convergence"]["fleet_sharded_ga_p99_s"] == merged["p99_s"]
 
 
 def test_metrics_snapshot_scraped_per_phase(bench_run, detail_path):

@@ -1,0 +1,358 @@
+"""Unit tier for the convergence SLO plane (ISSUE 9):
+``agac_tpu/observability/journey.py`` (lifecycle stamps, generation
+restarts, inflight/oldest views, id stability), ``slo.py`` (bucket
+accounting, multi-window burn rates, shed hysteresis, violations),
+and ``fleet.py`` (exposition parse/merge: counters+histograms summed,
+gauges shard-labeled, failed sources named).  The live wiring is
+covered by tests/test_observability.py (reconcile loop + endpoints)
+and the sim/process tiers.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from agac_tpu.observability import fleet, journey, slo
+from agac_tpu.observability.instruments import JOURNEY_BUCKETS
+from agac_tpu.observability.metrics import MetricsRegistry, parse_text
+
+GA = "global-accelerator-controller-service"
+R53 = "route53-controller-service"
+
+
+class FakeClock:
+    def __init__(self, now: float = 0.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def make_tracker(clock=None):
+    reg = MetricsRegistry()
+    clock = clock or FakeClock()
+    return journey.JourneyTracker(registry=reg, clock=clock), reg, clock
+
+
+# ---------------------------------------------------------------------------
+# journey tracker
+# ---------------------------------------------------------------------------
+
+
+class TestJourneyTracker:
+    def test_enqueue_to_converge_observes_latency(self):
+        tracker, reg, clock = make_tracker()
+        tracker.observe_enqueued(GA, "ns/a", generation=1)
+        clock.advance(42.0)
+        assert tracker.converged(GA, "ns/a") == pytest.approx(42.0)
+        samples = parse_text(reg.render())
+        assert samples[
+            'agac_journey_converge_seconds_count'
+            f'{{controller="{GA}",trigger="spec"}}'
+        ] == 1
+        assert samples[
+            'agac_journey_converge_seconds_sum'
+            f'{{controller="{GA}",trigger="spec"}}'
+        ] == pytest.approx(42.0)
+
+    def test_reenqueue_same_generation_keeps_the_clock(self):
+        tracker, _reg, clock = make_tracker()
+        tracker.observe_enqueued(GA, "ns/a", generation=1)
+        clock.advance(10.0)
+        tracker.observe_enqueued(GA, "ns/a", generation=1)
+        clock.advance(5.0)
+        assert tracker.converged(GA, "ns/a") == pytest.approx(15.0)
+
+    def test_newer_generation_restarts_the_clock(self):
+        # the user experiences latency to the edit they LAST wrote
+        tracker, _reg, clock = make_tracker()
+        tracker.observe_enqueued(GA, "ns/a", generation=1)
+        clock.advance(100.0)
+        tracker.observe_enqueued(GA, "ns/a", generation=2)
+        clock.advance(3.0)
+        assert tracker.converged(GA, "ns/a") == pytest.approx(3.0)
+
+    def test_close_of_unknown_key_is_a_noop(self):
+        tracker, _reg, _clock = make_tracker()
+        assert tracker.converged(GA, "ns/ghost") is None
+        assert tracker.deleted(GA, "ns/ghost") is None
+
+    def test_stage_counters_and_attempt_counts(self):
+        tracker, reg, _clock = make_tracker()
+        tracker.observe_enqueued(GA, "ns/a")
+        tracker.attempt(GA, "ns/a")
+        tracker.stage(GA, "ns/a", journey.STAGE_REQUEUED)
+        tracker.attempt(GA, "ns/a")
+        tracker.stage(GA, "ns/a", journey.STAGE_PARKED)
+        samples = parse_text(reg.render())
+        prefix = f'agac_journey_stages_total{{controller="{GA}",stage='
+        assert samples[prefix + '"enqueued"}'] == 1
+        assert samples[prefix + '"attempt"}'] == 2
+        assert samples[prefix + '"requeued"}'] == 1
+        assert samples[prefix + '"parked"}'] == 1
+
+    def test_inflight_and_oldest_age_views(self):
+        tracker, reg, clock = make_tracker()
+        tracker.observe_enqueued(GA, "ns/old")
+        clock.advance(30.0)
+        tracker.observe_enqueued(GA, "ns/new")
+        tracker.observe_enqueued(R53, "ns/other")
+        assert tracker.inflight() == 3
+        assert tracker.inflight(GA) == 2
+        assert tracker.oldest_age(GA) == pytest.approx(30.0)
+        samples = parse_text(reg.render())
+        assert samples[f'agac_journey_inflight{{controller="{GA}"}}'] == 2
+        assert samples[
+            f'agac_journey_oldest_unconverged_age_seconds{{controller="{GA}"}}'
+        ] == pytest.approx(30.0)
+        tracker.converged(GA, "ns/old")
+        assert tracker.oldest_age(GA) == pytest.approx(0.0)
+
+    def test_slowest_lists_oldest_first_with_ids(self):
+        tracker, _reg, clock = make_tracker()
+        tracker.observe_enqueued(GA, "ns/first", generation=3)
+        clock.advance(5.0)
+        tracker.observe_enqueued(GA, "ns/second")
+        slowest = tracker.slowest()
+        assert [j["key"] for j in slowest] == ["ns/first", "ns/second"]
+        assert slowest[0]["id"] == "ns/first@g3#1"
+        assert slowest[0]["id"] == tracker.journey_id(GA, "ns/first")
+
+    def test_drop_closes_without_observing_latency(self):
+        tracker, reg, clock = make_tracker()
+        tracker.observe_enqueued(GA, "ns/a")
+        clock.advance(1000.0)
+        tracker.drop(GA, "ns/a")
+        assert tracker.inflight() == 0
+        samples = parse_text(reg.render())
+        # nothing observed into the histogram — a dropped item is not
+        # a convergence
+        assert not any(
+            name.startswith("agac_journey_converge_seconds_count")
+            and value > 0
+            for name, value in samples.items()
+        )
+
+    def test_handoff_trigger_labels_the_histogram(self):
+        tracker, reg, clock = make_tracker()
+        tracker.observe_enqueued(
+            GA, "ns/adopted", trigger=journey.TRIGGER_HANDOFF
+        )
+        clock.advance(2.0)
+        tracker.converged(GA, "ns/adopted")
+        samples = parse_text(reg.render())
+        assert samples[
+            'agac_journey_converge_seconds_count'
+            f'{{controller="{GA}",trigger="handoff"}}'
+        ] == 1
+
+    def test_inflight_cap_drops_new_opens(self):
+        reg = MetricsRegistry()
+        tracker = journey.JourneyTracker(
+            registry=reg, clock=FakeClock(), max_inflight=2
+        )
+        tracker.observe_enqueued(GA, "ns/a")
+        tracker.observe_enqueued(GA, "ns/b")
+        tracker.observe_enqueued(GA, "ns/c")
+        assert tracker.inflight() == 2
+        assert tracker.dropped_total == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+def make_engine(**kwargs):
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    tracker = journey.JourneyTracker(registry=reg, clock=clock)
+    engine = slo.SLOEngine(
+        registry=reg, clock=clock, journey_tracker=tracker, **kwargs
+    )
+    return engine, tracker, clock, reg
+
+
+def converge_after(tracker, clock, key, seconds, controller=GA):
+    tracker.observe_enqueued(controller, key)
+    clock.advance(seconds)
+    tracker.converged(controller, key)
+
+
+class TestSLOEngine:
+    def test_threshold_must_sit_on_a_bucket_bound(self):
+        with pytest.raises(ValueError):
+            slo.SLOObjective("bad", 77.0, slo.GA_CONTROLLERS)
+        # every shipped objective aligns by construction
+        for objective in slo.default_objectives():
+            assert objective.threshold_seconds in JOURNEY_BUCKETS
+
+    def test_violations_on_cumulative_good_fraction(self):
+        engine, tracker, clock, _reg = make_engine()
+        converge_after(tracker, clock, "ns/fast", 5.0)
+        assert engine.violations() == []
+        converge_after(tracker, clock, "ns/slow", 500.0)
+        violations = engine.violations()
+        assert len(violations) == 1 and "ga_converge_p99" in violations[0]
+
+    def test_burn_rate_rises_and_decays_with_the_window(self):
+        engine, tracker, clock, _reg = make_engine(windows=(100.0, 1000.0))
+        # a healthy baseline so the long window has history
+        for i in range(50):
+            converge_after(tracker, clock, f"ns/ok{i}", 1.0)
+            clock.advance(10.0)
+            engine.tick()
+        # a burst of slow closures inside the short window
+        for i in range(5):
+            converge_after(tracker, clock, f"ns/slow{i}", 200.0)
+        burn = engine.tick()
+        short = burn["ga_converge_p99"][100.0]
+        assert short > 1.0  # 5 bad out of ~7 in-window >> the 1% budget
+        # let the burst age out of the short window: burn decays to 0
+        for i in range(30):
+            clock.advance(10.0)
+            burn = engine.tick()
+        assert burn["ga_converge_p99"][100.0] == 0.0
+
+    def test_shedding_trips_on_both_windows_and_clears_with_hysteresis(self):
+        engine, tracker, clock, _reg = make_engine(windows=(100.0, 400.0))
+        engine.tick()
+        # sustained badness: every closure blows the threshold
+        for i in range(12):
+            converge_after(tracker, clock, f"ns/slow{i}", 150.0)
+            clock.advance(30.0)
+            engine.tick()
+        assert engine.shedding
+        assert engine.shed_activations == 1
+        assert engine.should_shed("gc-sweep") is True
+        # recovery: good closures age the badness out of the short
+        # window; hysteresis clears at < shed_burn/2
+        for i in range(30):
+            converge_after(tracker, clock, f"ns/ok{i}", 1.0)
+            clock.advance(30.0)
+            engine.tick()
+        assert not engine.shedding
+        assert engine.should_shed("gc-sweep") is False
+
+    def test_shed_gates_off_observes_without_deferring(self):
+        engine, tracker, clock, _reg = make_engine(
+            windows=(100.0, 400.0), shed_gates=False
+        )
+        engine.tick()
+        for i in range(12):
+            converge_after(tracker, clock, f"ns/slow{i}", 150.0)
+            clock.advance(30.0)
+            engine.tick()
+        assert engine.shedding  # the state machine still runs
+        assert engine.shed_activations == 1
+        assert engine.should_shed("gc-sweep") is False  # but never defers
+
+    def test_global_gate_is_a_noop_without_an_engine(self):
+        previous = slo.install_engine(None)
+        try:
+            assert slo.should_shed("gc-sweep") is False
+            assert slo.status_or_disabled() == {"enabled": False}
+        finally:
+            slo.install_engine(previous)
+
+    def test_status_carries_objectives_and_slowest_journeys(self):
+        engine, tracker, clock, _reg = make_engine()
+        converge_after(tracker, clock, "ns/done", 5.0)
+        tracker.observe_enqueued(GA, "ns/stuck")
+        clock.advance(50.0)
+        engine.tick()
+        status = engine.status()
+        assert status["enabled"] is True
+        by_name = {o["name"]: o for o in status["objectives"]}
+        assert by_name["ga_converge_p99"]["journeys"] == 1
+        assert by_name["ga_converge_p99"]["healthy"] is True
+        # no record journeys yet: vacuously healthy, no data
+        assert by_name["record_converge_p99"]["journeys"] == 0
+        assert status["slowest_unconverged"][0]["key"] == "ns/stuck"
+        assert status["journeys"]["inflight"] == 1
+
+    def test_metrics_exported_on_tick(self):
+        engine, tracker, clock, reg = make_engine()
+        converge_after(tracker, clock, "ns/a", 5.0)
+        engine.tick()
+        samples = parse_text(reg.render())
+        assert samples['agac_slo_healthy{objective="ga_converge_p99"}'] == 1
+        assert 'agac_slo_burn_rate{objective="ga_converge_p99",window="300s"}' in samples
+        assert samples["agac_slo_shedding"] == 0
+        assert samples["agac_slo_evaluations_total"] == 1
+
+    def test_estimate_quantile_interpolates(self):
+        buckets = [(1.0, 10.0), (2.0, 20.0)]
+        assert slo.estimate_quantile(buckets, 20.0, 0.5) == pytest.approx(1.0)
+        assert slo.estimate_quantile(buckets, 20.0, 0.75) == pytest.approx(1.5)
+        assert slo.estimate_quantile([], 0.0, 0.99) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fleet merge
+# ---------------------------------------------------------------------------
+
+
+def render_replica(converge_count: int, keys_owned: int) -> str:
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    tracker = journey.JourneyTracker(registry=reg, clock=clock)
+    for i in range(converge_count):
+        converge_after(tracker, clock, f"ns/k{i}", 5.0)
+    reg.gauge("agac_shard_keys_owned", "keys").set(keys_owned)
+    reg.counter("agac_gc_sweeps_total", "sweeps").inc(3)
+    return reg.render()
+
+
+class TestFleetMerge:
+    def test_counters_and_histograms_sum_gauges_get_shard_labels(self):
+        merged, notes = fleet.merge_expositions(
+            {"r1": render_replica(2, 7), "r2": render_replica(3, 5)}
+        )
+        assert notes == []
+        text = fleet.render_families(merged)
+        samples = parse_text(text)
+        # histogram totals SUM across replicas
+        assert samples[
+            'agac_journey_converge_seconds_count'
+            f'{{controller="{GA}",trigger="spec"}}'
+        ] == 5
+        # counters sum (3 sweeps on each replica)
+        assert samples["agac_gc_sweeps_total"] == 6
+
+    def test_gauges_labeled_by_shard_never_summed(self):
+        merged, _ = fleet.merge_expositions(
+            {"r1": render_replica(0, 7), "r2": render_replica(0, 5)}
+        )
+        samples = merged["agac_shard_keys_owned"].samples
+        assert samples['agac_shard_keys_owned{shard="r1"}'] == 7
+        assert samples['agac_shard_keys_owned{shard="r2"}'] == 5
+        assert "agac_shard_keys_owned" not in samples  # no unlabeled sum
+
+    def test_failed_source_is_named_not_silent(self):
+        def boom():
+            raise OSError("connection refused")
+
+        view = fleet.FleetView(
+            {"alive": lambda: render_replica(1, 1), "dead": boom}
+        )
+        text = view.render()
+        assert "# fleet-source-failed: dead" in text
+        assert "# fleet-sources: alive" in text
+        samples = parse_text(text)
+        assert samples[
+            'agac_journey_converge_seconds_count'
+            f'{{controller="{GA}",trigger="spec"}}'
+        ] == 1
+
+    def test_converge_percentiles_from_merged_view(self):
+        merged, _ = fleet.merge_expositions(
+            {"r1": render_replica(4, 0), "r2": render_replica(4, 0)}
+        )
+        pcts = fleet.converge_percentiles(merged)
+        assert pcts["ga"]["count"] == 8
+        assert 0 < pcts["ga"]["p99_s"] <= 10.0
+        assert pcts["record"]["count"] == 0

@@ -365,6 +365,45 @@ class TestReconcileMetrics:
         assert [e["result"] for e in flight] == ["success", "error"]
         assert "boom" in flight[-1]["error"]
 
+    def test_flight_recorder_entries_carry_the_journey_id(self):
+        """The SLO plane's grep contract (ISSUE 9): a slow journey
+        surfaced by /slo is found in the flight recorder BY ITS ID —
+        every reconcile entry for an open journey carries it, and the
+        converging pass closes the journey."""
+        from agac_tpu.observability import journey, recorder
+
+        controller = threading.current_thread().name
+        tracker = journey.tracker()
+        queue = RateLimitingQueue(name="obs-journey")
+        try:
+            tracker.observe_enqueued(controller, "ns/tracked", generation=2)
+            journey_id = tracker.journey_id(controller, "ns/tracked")
+            assert journey_id.startswith("ns/tracked@g2#")
+            queue.add("ns/tracked")
+
+            def requeue_once(obj):
+                return Result(requeue=True)
+
+            self._drain(queue, requeue_once)  # requeued: journey stays open
+            self._drain(queue, lambda obj: Result())  # converges: closes
+        finally:
+            queue.shutdown()
+        flight = recorder.flight_recorder().dump()[-2:]
+        assert [e["journey"] for e in flight] == [journey_id, journey_id]
+        assert [e["result"] for e in flight] == ["requeue", "success"]
+        assert tracker.journey_id(controller, "ns/tracked") is None
+
+    def test_untracked_items_record_an_empty_journey_field(self):
+        from agac_tpu.observability import recorder
+
+        queue = RateLimitingQueue(name="obs-nojourney")
+        try:
+            queue.add("ns/untracked")
+            self._drain(queue, lambda obj: Result())
+        finally:
+            queue.shutdown()
+        assert recorder.flight_recorder().dump()[-1]["journey"] == ""
+
     def test_sampled_reconcile_emits_a_trace_with_queue_wait(self):
         emitted = []
         tracer = trace_mod.tracer()
@@ -459,6 +498,64 @@ class TestServerEndpoints:
             dump = json.loads(body)
             assert dump["capacity"] == 4
             assert dump["entries"][0]["key"] == "ns/x"
+
+            # the default fleet view serves this replica's own
+            # registry under /metrics/fleet (peers come via
+            # --fleet-peers); counters pass through unchanged
+            status, ctype, body = _get(base + "/metrics/fleet")
+            assert status == 200
+            assert ctype == CONTENT_TYPE
+            text = body.decode()
+            assert "# fleet-sources: self" in text
+            assert parse_text(text)["e2e_total"] == 5
         finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_slo_endpoint_and_healthz_block(self):
+        """/slo serves the engine's full view and /healthz carries the
+        summary block (ISSUE 9); without an installed engine both
+        degrade to {"enabled": false}."""
+        from agac_tpu.observability import journey as journey_mod
+        from agac_tpu.observability import slo as slo_mod
+
+        reg = MetricsRegistry()
+        tracker = journey_mod.JourneyTracker(registry=reg)
+        tracker.observe_enqueued(
+            "global-accelerator-controller-service", "ns/a"
+        )
+        engine = slo_mod.SLOEngine(registry=reg, journey_tracker=tracker)
+        engine.tick()
+        server = make_health_server(0, slo_status=engine.status)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            status, _ctype, body = _get(base + "/slo")
+            assert status == 200
+            view = json.loads(body)
+            assert view["enabled"] is True
+            names = {o["name"] for o in view["objectives"]}
+            assert "ga_converge_p99" in names and "drift_repair_p99" in names
+            assert view["slowest_unconverged"][0]["key"] == "ns/a"
+
+            status, _ctype, body = _get(base + "/healthz")
+            assert json.loads(body)["slo"]["enabled"] is True
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_slo_endpoint_disabled_without_engine(self):
+        from agac_tpu.observability import slo as slo_mod
+
+        previous = slo_mod.install_engine(None)
+        server = make_health_server(0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            status, _ctype, body = _get(base + "/slo")
+            assert status == 200
+            assert json.loads(body) == {"enabled": False}
+        finally:
+            slo_mod.install_engine(previous)
             server.shutdown()
             server.server_close()

@@ -32,6 +32,8 @@ import yaml
 from agac_tpu.cloudprovider.aws.fake_backend import FileBackedFakeAWSBackend
 from agac_tpu.cluster.rest import RestClusterClient
 from agac_tpu.cluster.testserver import TestApiServer
+from agac_tpu.observability import fleet as obs_fleet
+from agac_tpu.observability.metrics import parse_text
 from agac_tpu.sharding import HashRing
 
 from agac_tpu import apis
@@ -513,6 +515,28 @@ def healthz_sharding(port: int) -> dict | None:
         return None
 
 
+def scrape(port: int, path: str = "/metrics") -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as response:
+        return response.read().decode()
+
+
+def journey_counts(text: str) -> tuple[float, float, float]:
+    """(spec converges, handoff converges, inflight) summed across
+    controllers from one exposition (raw or fleet-merged)."""
+    spec = handoff = inflight = 0.0
+    for name, value in parse_text(text).items():
+        if name.startswith("agac_journey_converge_seconds_count{"):
+            if 'trigger="spec"' in name:
+                spec += value
+            elif 'trigger="handoff"' in name:
+                handoff += value
+        elif name.startswith("agac_journey_inflight"):
+            inflight += value
+    return spec, handoff, inflight
+
+
 class TestTwoShardProcessDrill:
     def test_two_live_replicas_split_keyspace_and_survive_kill(self, tmp_path):
         """Two REAL controller processes run concurrently under
@@ -529,10 +553,17 @@ class TestTwoShardProcessDrill:
             ports = [free_port(), free_port()]
             procs = []
             try:
-                for port in ports:
+                for i, port in enumerate(ports):
+                    # each replica's /metrics/fleet scrapes the OTHER
+                    # replica too (ISSUE 9: the fleet-merged view is
+                    # served by ANY replica)
+                    peer = f"127.0.0.1:{ports[1 - i]}"
                     procs.append(
                         drill.start(
-                            args=(*SHARD_ARGS, "--health-port", str(port)),
+                            args=(
+                                *SHARD_ARGS, "--health-port", str(port),
+                                "--fleet-peers", peer,
+                            ),
                             leader_election=True,  # sharded mode ignores the single-leader lease
                             extra_env=SHARD_LEASE_ENV,
                         )
@@ -574,6 +605,55 @@ class TestTwoShardProcessDrill:
                     lambda: chains_complete(n), timeout=60.0
                 ), f"fleet did not converge: {drill.aws().chain_counts()}"
 
+                # ------------------------------------------------------
+                # fleet-merged journey metrics (ISSUE 9): every spec
+                # journey converged on exactly ONE replica, the merged
+                # view equals the sum of the replicas' scrapes, and
+                # any replica serves it
+                # ------------------------------------------------------
+                def journeys_settled():
+                    specs, inflights = [], []
+                    for port in ports:
+                        spec, _handoff, inflight = journey_counts(scrape(port))
+                        specs.append(spec)
+                        inflights.append(inflight)
+                    return sum(specs) == n and sum(inflights) == 0
+
+                assert wait_until(journeys_settled, timeout=20.0), [
+                    journey_counts(scrape(port)) for port in ports
+                ]
+                texts = [scrape(port) for port in ports]
+                per_replica_spec = [journey_counts(t)[0] for t in texts]
+                assert sum(per_replica_spec) == n
+                assert all(spec > 0 for spec in per_replica_spec), (
+                    "both replicas must have converged journeys"
+                )
+                # the manually merged scrape == the served fleet view
+                merged_families, notes = obs_fleet.merge_expositions(
+                    {"a": texts[0], "b": texts[1]}
+                )
+                manual = parse_text(obs_fleet.render_families(merged_families))
+                for port in ports:
+                    served = parse_text(scrape(port, "/metrics/fleet"))
+                    spec, _handoff, inflight = journey_counts(
+                        scrape(port, "/metrics/fleet")
+                    )
+                    assert spec == n, "fleet view must carry the whole fleet"
+                    assert inflight == 0
+                    # counters agree sample-by-sample with the manual
+                    # merge (journey histograms included)
+                    for name, value in manual.items():
+                        if name.startswith("agac_journey_converge_seconds"):
+                            assert served.get(name) == value, name
+                # per-replica keys_owned survive as shard-labeled
+                # gauges, never a summed series
+                fleet_text = scrape(ports[0], "/metrics/fleet")
+                owned_series = [
+                    name for name in parse_text(fleet_text)
+                    if name.startswith("agac_shard_keys_owned{")
+                ]
+                assert len(owned_series) == 2, owned_series
+
                 # kill the replica that owns shard 0 (kill -9: leases
                 # NOT released)
                 views = shard_views()
@@ -611,5 +691,34 @@ class TestTwoShardProcessDrill:
                 view = healthz_sharding(survivor_port)
                 assert view["quota_fraction"] == 1.0
                 assert view["live_shards"] == 2
+
+                # ------------------------------------------------------
+                # journeys across the kill -9 (ISSUE 9): the orphan key
+                # (created while nobody owned shard 0) converges as a
+                # HANDOFF journey on the survivor, nothing stays
+                # in-flight, and the fleet view degrades to the
+                # survivor alone — dead peer NAMED, counts equal to
+                # the survivor's own scrape, never doubled
+                # ------------------------------------------------------
+                def survivor_journeys_settled():
+                    _spec, handoff, inflight = journey_counts(
+                        scrape(survivor_port)
+                    )
+                    return handoff >= 1 and inflight == 0
+
+                assert wait_until(survivor_journeys_settled, timeout=20.0), (
+                    journey_counts(scrape(survivor_port))
+                )
+                fleet_text = scrape(survivor_port, "/metrics/fleet")
+                assert "# fleet-source-failed: " in fleet_text, (
+                    "the dead peer must be NAMED as a failed source"
+                )
+                own = parse_text(scrape(survivor_port))
+                merged = parse_text(fleet_text)
+                for name, value in own.items():
+                    if name.startswith("agac_journey_converge_seconds"):
+                        assert merged.get(name) == value, (
+                            f"failover fleet view lost/doubled {name}"
+                        )
             finally:
                 drill.stop_all()

@@ -412,6 +412,36 @@ class TestScenarioFuzzer:
         assert replay.trace_hash == result.trace_hash
         assert replay.violations == result.violations
 
+    def test_no_faults_run_passes_the_slo_oracle(self):
+        """The SLO oracle's clean half (ISSUE 9): with every fault
+        composition dropped, the churn-only scenario must meet every
+        convergence objective — a fault-free run that misses p99 is a
+        real regression, and the oracle is ARMED (its violations fail
+        the scenario)."""
+        result = fuzz.run_scenario(MINI_SEED, profile="mini", no_faults=True)
+        assert result.ok, result.violations
+        slo_stats = result.stats["slo"]
+        assert slo_stats["violations"] == []
+        # journeys were actually measured, not vacuously absent
+        assert slo_stats["journeys"]["converged_total"] > 0
+        assert slo_stats["journeys"]["inflight"] == 0
+
+    def test_canary_slo_brownout_is_caught_and_sheds(self):
+        """Mutation run (ISSUE 9): a sustained GA brownout must trip
+        the convergence-SLO oracle AND be observed driving burn-gated
+        shedding of deferrable load — an SLO plane that cannot fail,
+        or a shed gate that never fires, proves nothing."""
+        result = fuzz.run_scenario(
+            MINI_SEED, profile="mini", canary="slo-brownout", no_faults=True
+        )
+        assert not result.ok
+        assert any(v.startswith("slo:") for v in result.violations), (
+            result.violations
+        )
+        assert result.stats["slo"]["shed_activations"] >= 1, (
+            "burn-gated shedding was never observed"
+        )
+
     def test_canary_gc_stale_owner_cache_is_caught(self):
         """Mutation run: a GC sweeper trusting a stale owner cache
         (grace disabled) reaps live owners — the live-owner deletion
