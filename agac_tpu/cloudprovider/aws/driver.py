@@ -457,11 +457,44 @@ class AWSDriver:
             if self._discovery_cache is not None
             else {}
         )
+        accelerators = self._list_accelerators()
+        unknown = [
+            accelerator
+            for accelerator in accelerators
+            if accelerator.accelerator_arn not in known
+        ]
+        fetched: dict[str, list] = {}
+        if len(unknown) > 4 and clockseam.threads_enabled():
+            # cold-fill fan-out (ISSUE 10): a replica whose FIRST fill
+            # meets an already-populated account (a sharded joiner, a
+            # failover adopter) owes one ListTags per existing
+            # accelerator — serially that is O(fleet) x wire latency
+            # with every worker single-flighted behind it (observed as
+            # multi-second convergence stalls in the 4/8-shard sweep).
+            # Real AWS serves these reads concurrently; a bounded pool
+            # cuts the fill to O(fleet/8).  Threadless runtimes (the
+            # sim) keep the serial loop — deterministic by design.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                for accelerator, tags in zip(
+                    unknown,
+                    pool.map(
+                        lambda a: self.ga.list_tags_for_resource(
+                            a.accelerator_arn
+                        ),
+                        unknown,
+                    ),
+                ):
+                    fetched[accelerator.accelerator_arn] = tags
         pairs = []
-        for accelerator in self._list_accelerators():
-            tags = known.get(accelerator.accelerator_arn)
+        for accelerator in accelerators:
+            arn = accelerator.accelerator_arn
+            tags = known.get(arn)
             if tags is None:
-                tags = self.ga.list_tags_for_resource(accelerator.accelerator_arn)
+                tags = fetched.get(arn)
+            if tags is None:
+                tags = self.ga.list_tags_for_resource(arn)
             pairs.append((accelerator, tags))
         return pairs
 

@@ -65,7 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
         "reconciles only the keys its held shards own, with the AWS "
         "quota divided per shard. Replaces classic single-leader "
         "election. 1 (default) disables: one active leader owns "
-        "everything.",
+        "everything. This is the BOOT count; the live count follows "
+        "the ring lease — change it at runtime with the "
+        "`resize-shards` subcommand (drain/handoff-mediated, no "
+        "restart).",
     )
     controller.add_argument(
         "--shards-per-replica", type=int, default=0,
@@ -250,6 +253,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="Webhook server use SSL.",
     )
 
+    resize = sub.add_parser(
+        "resize-shards",
+        help="Live-resize a sharded fleet (ISSUE 10): CAS the new "
+        "shard-count target onto the ring lease; every replica's next "
+        "membership tick starts the drain/handoff transition — no "
+        "restarts, no unowned keys beyond one handoff window.",
+    )
+    resize.add_argument(
+        "-n", "--shard-count", type=int, required=True,
+        help="Target shard count (the live hash ring resizes to it).",
+    )
+    resize.add_argument(
+        "--kubeconfig", default="",
+        help="Path to a kubeconfig. Only required if out-of-cluster.",
+    )
+    resize.add_argument(
+        "--master", default="",
+        help="The address of the Kubernetes API server. Overrides any "
+        "value in kubeconfig.",
+    )
+    resize.add_argument(
+        "--force", action="store_true",
+        help="Supersede an in-flight transition (only when the fleet "
+        "is wedged — a forced restart recomputes every replica's plan).",
+    )
+
     sub.add_parser("version", help="Print the version number")
 
     manifests = sub.add_parser(
@@ -421,6 +450,7 @@ def run_controller(args) -> int:
         health_server = make_health_server(
             args.health_port, health=tracker, gc_status=manager.gc_status,
             shard_status=manager.shard_status, fleet_view=fleet_view,
+            queue_status=manager.queue_status,
         )
         threading.Thread(
             target=health_server.serve_forever, daemon=True, name="health-server"
@@ -473,6 +503,31 @@ def run_controller(args) -> int:
     return 0
 
 
+def run_resize_shards(args) -> int:
+    from ..cluster.rest import build_client
+    from ..sharding import request_resize
+
+    kubeconfig = resolve_kubeconfig(args.kubeconfig)
+    try:
+        client = build_client(kubeconfig, args.master)
+    except Exception as err:
+        klog.errorf("Error building rest config: %s", err)
+        return 1
+    namespace = os.environ.get("POD_NAMESPACE") or "kube-system"
+    try:
+        epoch = request_resize(
+            client, args.shard_count, namespace=namespace, force=args.force
+        )
+    except Exception as err:
+        print(f"resize refused: {err}", file=sys.stderr)
+        return 1
+    print(
+        f"resize to {args.shard_count} shards requested (epoch {epoch}); "
+        "watch /healthz sharding.resize until state returns to 'stable'"
+    )
+    return 0
+
+
 def run_webhook(args) -> int:
     from ..webhook import Server
 
@@ -512,6 +567,8 @@ def main(argv=None) -> int:
     klog.init(verbosity=args.verbosity)
     if args.command == "controller":
         return run_controller(args)
+    if args.command == "resize-shards":
+        return run_resize_shards(args)
     if args.command == "webhook":
         return run_webhook(args)
     if args.command == "version":

@@ -133,6 +133,29 @@ def with_circuit_backoff(process):
     return wrapped
 
 
+def with_shard_guard(shard_filter, process):
+    """Wrap a process func with a pop-time ownership re-check (ISSUE
+    10): enqueue gates keep foreign keys out of the queue, but a key
+    can re-home BETWEEN enqueue and pop — a live-resize drain, or a
+    lease lost to a steal.  Working such residue would race the new
+    owner's reconcile of the same key (the double-mutation the
+    drain/handoff protocol exists to prevent), so the worker skips it:
+    ``Result(skip=True)`` forgets the item without closing its journey
+    and without any AWS call having run.  ``OWNS_ALL`` short-circuits,
+    so single-shard mode pays nothing."""
+    if shard_filter is None or shard_filter.all_shards:
+        return process
+
+    def guarded(arg):
+        key = arg if isinstance(arg, str) else meta_namespace_key(arg)
+        if not shard_filter.owns_key(key):
+            return Result(skip=True)
+        return process(arg)
+
+    guarded.__name__ = getattr(process, "__name__", "process")
+    return guarded
+
+
 def run_workers(
     name: str,
     queue: RateLimitingQueue,

@@ -50,6 +50,7 @@ from .common import (
     lb_name_region_or_warn,
     make_sync_error_warner,
     run_workers,
+    with_shard_guard,
     stamp_journey_enqueued,
     start_drift_resync,
 )
@@ -159,8 +160,14 @@ class EndpointGroupBindingController:
                 name=CONTROLLER_AGENT_NAME,
                 queue=self.workqueue,
                 key_to_obj=self._key_to_binding,
-                process_delete=self._process_deleted_key,
-                process_create_or_update=self.reconcile,
+                # pop-time ownership re-check (ISSUE 10): residue of a
+                # resize drain or lease steal is skipped, not worked
+                process_delete=with_shard_guard(
+                    self._shards, self._process_deleted_key
+                ),
+                process_create_or_update=with_shard_guard(
+                    self._shards, self.reconcile
+                ),
                 on_sync_result=make_sync_error_warner(
                     self.recorder, self._key_to_binding
                 ),

@@ -38,6 +38,7 @@ from .common import (
     run_workers,
     stamp_journey_enqueued,
     start_drift_resync,
+    with_shard_guard,
     unwrap_tombstone,
     was_alb_ingress,
     was_load_balancer_service,
@@ -142,11 +143,22 @@ class GlobalAcceleratorController:
     # event handlers (reference ``controller.go:91-173``)
     # ------------------------------------------------------------------
     def _add_service_notification(self, svc) -> None:
-        if is_managed_service(svc):
+        # structural gate, NOT the managed annotation: ADD events are
+        # what replay on informer sync (boot, leadership, shard
+        # adoption), so they are the level-triggered recovery edge for
+        # a delete/unmanage consumed while the key was unowned — a
+        # namesake re-created WITHOUT the annotation must still get
+        # one cleanup reconcile, or its old chain leaks forever (GC
+        # never touches resources whose owner object exists).  Only
+        # managed objects open a user-facing journey — the recovery
+        # check is not a convergence anyone waits on.
+        if was_load_balancer_service(svc):
             klog.v(4).infof(
                 "Service %s/%s is created", svc.metadata.namespace, svc.metadata.name
             )
-            self._enqueue(self.service_queue, svc)
+            self._enqueue(
+                self.service_queue, svc, journey=is_managed_service(svc)
+            )
 
     def _update_service_notification(self, old, new) -> None:
         if old == new:
@@ -175,13 +187,17 @@ class GlobalAcceleratorController:
             self._enqueue(self.service_queue, svc)
 
     def _add_ingress_notification(self, ingress) -> None:
-        if is_managed_ingress(ingress):
+        # structural gate (see _add_service_notification): recovery of
+        # cleanups consumed while the key was unowned
+        if was_alb_ingress(ingress):
             klog.v(4).infof(
                 "Ingress %s/%s is created",
                 ingress.metadata.namespace,
                 ingress.metadata.name,
             )
-            self._enqueue(self.ingress_queue, ingress)
+            self._enqueue(
+                self.ingress_queue, ingress, journey=is_managed_ingress(ingress)
+            )
 
     def _update_ingress_notification(self, old, new) -> None:
         if old == new:
@@ -210,18 +226,27 @@ class GlobalAcceleratorController:
         )
         self._enqueue(self.ingress_queue, ingress)
 
-    def _enqueue(self, queue: RateLimitingQueue, obj) -> None:
+    def _enqueue(
+        self, queue: RateLimitingQueue, obj, journey: bool = True
+    ) -> None:
         key = meta_namespace_key(obj)
         if not self._shards.owns_key(key):
             return  # another shard's replica reconciles this key
-        stamp_journey_enqueued(queue.name, obj)
+        if journey:
+            stamp_journey_enqueued(queue.name, obj)
         queue.add_rate_limited(key)
 
-    def _resync_enqueue(self, queue: RateLimitingQueue, obj, trigger: str) -> None:
+    def _resync_enqueue(
+        self, queue: RateLimitingQueue, obj, trigger: str,
+        journey: bool = True,
+    ) -> None:
         """Drift/handoff re-enqueue: journey-stamped with its trigger,
         then the plain dedup add (NOT add_rate_limited — the client-go
-        resync pattern; see the run() comment)."""
-        stamp_journey_enqueued(queue.name, obj, trigger=trigger)
+        resync pattern; see the run() comment).  ``journey=False`` for
+        cleanup-recovery enqueues of unmanaged objects — not a
+        convergence anyone waits on."""
+        if journey:
+            stamp_journey_enqueued(queue.name, obj, trigger=trigger)
         queue.add(meta_namespace_key(obj))
 
     # ------------------------------------------------------------------
@@ -262,8 +287,14 @@ class GlobalAcceleratorController:
                 name=f"{CONTROLLER_AGENT_NAME}-service",
                 queue=self.service_queue,
                 key_to_obj=self._key_to_service,
-                process_delete=self.process_service_delete,
-                process_create_or_update=self.process_service_create_or_update,
+                # pop-time ownership re-check (ISSUE 10): residue of a
+                # resize drain or lease steal is skipped, not worked
+                process_delete=with_shard_guard(
+                    self._shards, self.process_service_delete
+                ),
+                process_create_or_update=with_shard_guard(
+                    self._shards, self.process_service_create_or_update
+                ),
                 on_sync_result=make_sync_error_warner(
                     self.recorder, self._key_to_service
                 ),
@@ -273,8 +304,12 @@ class GlobalAcceleratorController:
                 name=f"{CONTROLLER_AGENT_NAME}-ingress",
                 queue=self.ingress_queue,
                 key_to_obj=self._key_to_ingress,
-                process_delete=self.process_ingress_delete,
-                process_create_or_update=self.process_ingress_create_or_update,
+                process_delete=with_shard_guard(
+                    self._shards, self.process_ingress_delete
+                ),
+                process_create_or_update=with_shard_guard(
+                    self._shards, self.process_ingress_create_or_update
+                ),
                 on_sync_result=make_sync_error_warner(
                     self.recorder, self._key_to_ingress
                 ),
@@ -292,16 +327,32 @@ class GlobalAcceleratorController:
         diverge.  ``trigger`` labels the journeys these enqueues open
         (drift ticks vs. the manager's shard-handoff resync)."""
         owns = self._shards.owns_obj  # shard-aware: foreign keys never tick
+        if trigger == obs_journey.TRIGGER_DRIFT:
+            svc_pred, ing_pred = is_managed_service, is_managed_ingress
+        else:
+            # handoff/resize adoptions are level-triggered RECOVERY: a
+            # managed annotation REMOVED while the key was unowned (its
+            # event consumed by a dead replica, or landing in the
+            # drain→adopt gap) still has AWS state to tear down, so the
+            # net widens to every object that could carry a chain — an
+            # unmanaged one reconciles to a cheap cleanup check
+            svc_pred, ing_pred = was_load_balancer_service, was_alb_ingress
         return [
             (
                 self.service_lister,
-                lambda svc: is_managed_service(svc) and owns(svc),
-                lambda svc: self._resync_enqueue(self.service_queue, svc, trigger),
+                lambda svc: svc_pred(svc) and owns(svc),
+                lambda svc: self._resync_enqueue(
+                    self.service_queue, svc, trigger,
+                    journey=is_managed_service(svc),
+                ),
             ),
             (
                 self.ingress_lister,
-                lambda ing: is_managed_ingress(ing) and owns(ing),
-                lambda ing: self._resync_enqueue(self.ingress_queue, ing, trigger),
+                lambda ing: ing_pred(ing) and owns(ing),
+                lambda ing: self._resync_enqueue(
+                    self.ingress_queue, ing, trigger,
+                    journey=is_managed_ingress(ing),
+                ),
             ),
         ]
 

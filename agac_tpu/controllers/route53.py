@@ -36,6 +36,7 @@ from .common import (
     lb_name_region_or_warn,
     make_sync_error_warner,
     run_workers,
+    with_shard_guard,
     stamp_journey_enqueued,
     start_drift_resync,
     unwrap_tombstone,
@@ -133,8 +134,20 @@ class Route53Controller:
     # event handlers (reference ``route53/controller.go:89-170``)
     # ------------------------------------------------------------------
     def _add_service_notification(self, svc) -> None:
-        if is_hostname_managed_service(svc):
-            self._enqueue(self.service_queue, svc)
+        # structural gate, NOT the hostname annotation: ADD events
+        # replay on informer sync (boot, leadership, shard adoption) —
+        # the level-triggered recovery edge for an annotation removal
+        # or delete consumed while the key was unowned.  A namesake
+        # re-created WITHOUT the annotation must still get one cleanup
+        # reconcile (memoized by ``_cleaned_up``), or its old records
+        # leak forever: GC never sweeps records whose owner object
+        # exists.  Only annotated objects open a user-facing journey —
+        # a cleanup-recovery check is not a convergence anyone waits on.
+        if was_load_balancer_service(svc):
+            self._enqueue(
+                self.service_queue, svc,
+                journey=is_hostname_managed_service(svc),
+            )
 
     def _update_service_notification(self, old, new) -> None:
         if old == new:
@@ -154,9 +167,15 @@ class Route53Controller:
 
     def _add_ingress_notification(self, ingress) -> None:
         # the reference gates ingress adds on the hostname annotation
-        # only, not the ALB predicate (``route53/controller.go:131-136``)
-        if is_hostname_managed_ingress(ingress):
-            self._enqueue(self.ingress_queue, ingress)
+        # only (``route53/controller.go:131-136``); the gate here is
+        # wider still — ANY ingress add, matching the delete handler —
+        # so a cleanup consumed while the key was unowned is recovered
+        # by the informer-sync ADD replay (see
+        # _add_service_notification)
+        self._enqueue(
+            self.ingress_queue, ingress,
+            journey=is_hostname_managed_ingress(ingress),
+        )
 
     def _update_ingress_notification(self, old, new) -> None:
         if old == new:
@@ -172,17 +191,25 @@ class Route53Controller:
             return
         self._enqueue(self.ingress_queue, ingress)
 
-    def _enqueue(self, queue: RateLimitingQueue, obj) -> None:
+    def _enqueue(
+        self, queue: RateLimitingQueue, obj, journey: bool = True
+    ) -> None:
         key = meta_namespace_key(obj)
         if not self._shards.owns_key(key):
             return  # another shard's replica reconciles this key
-        stamp_journey_enqueued(queue.name, obj)
+        if journey:
+            stamp_journey_enqueued(queue.name, obj)
         queue.add_rate_limited(key)
 
-    def _resync_enqueue(self, queue: RateLimitingQueue, obj, trigger: str) -> None:
+    def _resync_enqueue(
+        self, queue: RateLimitingQueue, obj, trigger: str,
+        journey: bool = True,
+    ) -> None:
         """Drift/handoff re-enqueue: journey-stamped, then the plain
-        dedup add (the client-go resync pattern)."""
-        stamp_journey_enqueued(queue.name, obj, trigger=trigger)
+        dedup add (the client-go resync pattern).  ``journey=False``
+        for cleanup-recovery enqueues of unannotated objects."""
+        if journey:
+            stamp_journey_enqueued(queue.name, obj, trigger=trigger)
         queue.add(meta_namespace_key(obj))
 
     def drift_resync_sources(
@@ -194,16 +221,34 @@ class Route53Controller:
         measurement), so the two can never diverge.  ``trigger``
         labels the journeys these enqueues open."""
         owns = self._shards.owns_obj  # shard-aware: foreign keys never tick
+        if trigger == obs_journey.TRIGGER_DRIFT:
+            svc_pred, ing_pred = (
+                is_hostname_managed_service,
+                is_hostname_managed_ingress,
+            )
+        else:
+            # handoff/resize adoptions widen to every candidate object:
+            # a hostname annotation REMOVED while the key was unowned
+            # still has records to clean up (the cleanup reconcile of an
+            # unannotated object is cheap and `_cleaned_up`-memoized)
+            svc_pred = was_load_balancer_service
+            ing_pred = lambda ing: True  # noqa: E731 — symmetric shape
         return [
             (
                 self.service_lister,
-                lambda svc: is_hostname_managed_service(svc) and owns(svc),
-                lambda svc: self._resync_enqueue(self.service_queue, svc, trigger),
+                lambda svc: svc_pred(svc) and owns(svc),
+                lambda svc: self._resync_enqueue(
+                    self.service_queue, svc, trigger,
+                    journey=is_hostname_managed_service(svc),
+                ),
             ),
             (
                 self.ingress_lister,
-                lambda ing: is_hostname_managed_ingress(ing) and owns(ing),
-                lambda ing: self._resync_enqueue(self.ingress_queue, ing, trigger),
+                lambda ing: ing_pred(ing) and owns(ing),
+                lambda ing: self._resync_enqueue(
+                    self.ingress_queue, ing, trigger,
+                    journey=is_hostname_managed_ingress(ing),
+                ),
             ),
         ]
 
@@ -216,8 +261,14 @@ class Route53Controller:
                 name=f"{CONTROLLER_AGENT_NAME}-service",
                 queue=self.service_queue,
                 key_to_obj=self._key_to_service,
-                process_delete=self.process_service_delete,
-                process_create_or_update=self.process_service_create_or_update,
+                # pop-time ownership re-check (ISSUE 10): residue of a
+                # resize drain or lease steal is skipped, not worked
+                process_delete=with_shard_guard(
+                    self._shards, self.process_service_delete
+                ),
+                process_create_or_update=with_shard_guard(
+                    self._shards, self.process_service_create_or_update
+                ),
                 on_sync_result=make_sync_error_warner(
                     self.recorder, self._key_to_service
                 ),
@@ -227,8 +278,12 @@ class Route53Controller:
                 name=f"{CONTROLLER_AGENT_NAME}-ingress",
                 queue=self.ingress_queue,
                 key_to_obj=self._key_to_ingress,
-                process_delete=self.process_ingress_delete,
-                process_create_or_update=self.process_ingress_create_or_update,
+                process_delete=with_shard_guard(
+                    self._shards, self.process_ingress_delete
+                ),
+                process_create_or_update=with_shard_guard(
+                    self._shards, self.process_ingress_create_or_update
+                ),
                 on_sync_result=make_sync_error_warner(
                     self.recorder, self._key_to_ingress
                 ),

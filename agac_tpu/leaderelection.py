@@ -73,6 +73,11 @@ class LeaderElection:
         self.namespace = namespace
         self.config = config or LeaderElectionConfig()
         self.identity = identity or str(uuid.uuid4())
+        # optional annotations folded into every lease record this
+        # elector writes (shard membership publishes its measured
+        # keys-owned here for load-aware placement, ISSUE 10); None
+        # (default) leaves lease metadata untouched
+        self.annotation_provider: Optional[Callable[[], dict]] = None
         # the local monotonic clock all freshness decisions run on —
         # virtual under the sim runtime (ISSUE 7), where lease churn
         # plays out in virtual seconds
@@ -196,7 +201,10 @@ class LeaderElection:
             lease = client.get("Lease", self.namespace, self.name)
         except NotFoundError:
             lease = Lease(
-                metadata=ObjectMeta(name=self.name, namespace=self.namespace),
+                metadata=ObjectMeta(
+                    name=self.name, namespace=self.namespace,
+                    annotations=self._annotations(),
+                ),
                 spec=LeaseSpec(
                     holder_identity=self.identity,
                     lease_duration_seconds=int(self.config.lease_duration),
@@ -246,6 +254,11 @@ class LeaderElection:
         lease.spec.holder_identity = self.identity
         lease.spec.renew_time = now
         lease.spec.lease_duration_seconds = int(self.config.lease_duration)
+        annotations = self._annotations()
+        if annotations:
+            if lease.metadata.annotations is None:
+                lease.metadata.annotations = {}
+            lease.metadata.annotations.update(annotations)
         try:
             client.update("Lease", lease)
             if took_over:
@@ -256,6 +269,14 @@ class LeaderElection:
         except Exception as err:
             klog.errorf("error updating lease: %s", err)
             return False, holder
+
+    def _annotations(self) -> dict:
+        if self.annotation_provider is None:
+            return {}
+        try:
+            return dict(self.annotation_provider())
+        except Exception:
+            return {}
 
     def release(self, client: ClusterClient) -> None:
         """Public release for cooperative drivers (shard membership,

@@ -201,6 +201,13 @@ class Manager:
                 registry=self.metrics_registry,
                 on_change=self._on_shard_change,
             )
+            # entering a resize transition re-divides quota (the
+            # denominator grew to max(from, to)) without the full
+            # handoff resync an ownership change triggers
+            self.shard_membership.on_quota_change = self._on_shard_quota_change
+            # load-aware placement input (ISSUE 10): measured managed
+            # keys per shard under the live ring
+            self.shard_membership.fleet_key_counts = self._count_keys_by_shard
             self.shard_filter = self.shard_membership.filter
             obs_instruments.sharding_instruments(
                 self.metrics_registry
@@ -321,19 +328,71 @@ class Manager:
             quota_fraction=round(membership.quota_fraction(), 4),
         )
 
+    def _on_shard_quota_change(self, membership: ShardMembership) -> None:
+        """A resize transition began: the quota denominator moved but
+        no shard changed hands — re-divide without the full handoff
+        resync."""
+        if self._health is not None:
+            self._health.set_quota_fraction(membership.quota_fraction())
+        obs_recorder.flight_recorder().record(
+            "shard-resize",
+            state=membership.resize_status().get("state"),
+            epoch=membership.resize_epoch,
+            quota_fraction=round(membership.quota_fraction(), 4),
+        )
+
     def shard_tick(self, client: ClusterClient) -> bool:
         """One membership round plus (when ownership changed and the
         informer caches are synced) the adopted-keyspace resync — the
         cooperative entry point the threaded loop AND the sim harness
         both drive, so the two runtimes cannot diverge on failover
-        semantics.  Returns True when the owned-shard set changed."""
+        semantics.  Returns True when the owned-shard set changed.
+
+        During a live resize (ISSUE 10) the tick also drives this
+        replica's side of the drain/handoff protocol: shards adopted
+        this round get their moved keys resynced (journeys stamped
+        ``trigger=resize``) and the handoff ack is written only AFTER
+        that resync ran — the marker in the lease record is the
+        protocol's statement that the new owner is actually serving."""
         if self.shard_membership is None:
             return False
         changed = self.shard_membership.tick(client)
+        if self.shard_membership.resync_pending() and self._informers_synced():
+            moved = self.shard_membership.moved_key_predicate()
+            if self.on_reshard is not None:
+                # the gained keys were written by other processes:
+                # every local snapshot is suspect (duplicate-accelerator
+                # hazard, same as a failover adoption)
+                self.on_reshard()
+            enqueued = self._resync_sources(
+                trigger=obs_journey.TRIGGER_RESIZE,
+                key_predicate=moved,
+            )
+            klog.infof(
+                "resize resync: re-enqueued %d re-homed keys for shards %s",
+                enqueued, self.shard_filter.token(),
+            )
+            self.shard_membership.ack_adoptions(client)
         if self._reshard_pending and self._informers_synced():
             self._reshard_pending = False
             self.reshard_resync()
         return changed
+
+    def request_resize(self, client: ClusterClient, target_count: int) -> int:
+        """CAS the fleet's live shard-count target onto the ring lease
+        (the ``resize-shards`` CLI calls the module function directly;
+        this is the embedded/test entry point)."""
+        from .sharding import request_resize as _request_resize
+
+        membership = self.shard_membership
+        if membership is None:
+            raise RuntimeError("sharding is not enabled on this manager")
+        return _request_resize(
+            client, target_count,
+            namespace=membership.config.namespace,
+            lease_prefix=membership.config.lease_prefix,
+            vnodes=membership.config.vnodes,
+        )
 
     def _informers_synced(self) -> bool:
         if self.informer_factory is None:
@@ -354,30 +413,47 @@ class Manager:
             # fresh reads for an adopted keyspace: another process
             # wrote it, local snapshots would ensure duplicates
             self.on_reshard()
-        enqueued = 0
-        for controller in self.controllers.values():
-            # journeys opened by this resync are HANDOFF-triggered: the
-            # adopted keys' convergence latency is failover cost, not a
-            # spec edit's, and the SLO plane separates the two
-            for lister, predicate, enqueue in controller.drift_resync_sources(
-                trigger=obs_journey.TRIGGER_HANDOFF
-            ):
-                for obj in lister.list():
-                    if predicate(obj):
-                        enqueue(obj)
-                        enqueued += 1
+        # journeys opened by this resync are HANDOFF-triggered: the
+        # adopted keys' convergence latency is failover cost, not a
+        # spec edit's, and the SLO plane separates the two
+        enqueued = self._resync_sources(trigger=obs_journey.TRIGGER_HANDOFF)
         klog.infof(
             "shard resync: re-enqueued %d keys for shards %s",
             enqueued, self.shard_filter.token(),
         )
         return enqueued
 
+    def _resync_sources(
+        self, trigger: str, key_predicate=None
+    ) -> int:
+        """Walk every controller's canonical drift sources, enqueueing
+        owned objects (optionally narrowed by ``key_predicate`` over
+        the ``namespace/name`` key — the resize resync only re-homes
+        MOVED keys)."""
+        from .cluster.objects import meta_namespace_key
+
+        enqueued = 0
+        for controller in self.controllers.values():
+            for lister, predicate, enqueue in controller.drift_resync_sources(
+                trigger=trigger
+            ):
+                for obj in lister.list():
+                    if not predicate(obj):
+                        continue
+                    if key_predicate is not None and not key_predicate(
+                        meta_namespace_key(obj)
+                    ):
+                        continue
+                    enqueue(obj)
+                    enqueued += 1
+        return enqueued
+
     def _shard_loop(self, client: ClusterClient, stop: threading.Event) -> None:
         membership = self.shard_membership
         klog.infof(
             "Starting shard membership (identity %s, %d shards, capacity %d)",
-            membership.identity, membership.config.shard_count,
-            membership.config.max_shards,
+            membership.identity, membership.shard_count,
+            membership.capacity(),
         )
         while not stop.is_set():
             try:
@@ -399,6 +475,9 @@ class Manager:
             self.shard_membership.quota_fraction(), 4
         )
         status["keys_owned"] = self._count_owned_keys()
+        # elastic resharding (ISSUE 10): ring version, resize state
+        # (stable/draining/adopting) and per-shard handoff progress
+        status["resize"] = self.shard_membership.resize_status()
         return status
 
     def _count_owned_keys(self) -> int:
@@ -422,6 +501,35 @@ class Manager:
         except Exception:
             return count
         return count
+
+    def _count_keys_by_shard(self) -> dict[int, int]:
+        """Managed keys per shard under the LIVE ring — the measured
+        load the membership's preferred-owner placement scores claims
+        and sheds by (ISSUE 10).  Counts the whole fleet (not only
+        owned shards): a claim decision needs the weight of shards
+        this replica does NOT hold yet."""
+        if self.informer_factory is None or self.shard_membership is None:
+            return {}
+        from .cluster.objects import meta_namespace_key
+        from .controllers.globalaccelerator import (
+            is_managed_ingress,
+            is_managed_service,
+        )
+
+        ring = self.shard_membership.ring
+        counts: dict[int, int] = {}
+        try:
+            for obj in self.informer_factory.informer("Service").lister().list():
+                if is_managed_service(obj):
+                    shard = ring.shard_for_key(meta_namespace_key(obj))
+                    counts[shard] = counts.get(shard, 0) + 1
+            for obj in self.informer_factory.informer("Ingress").lister().list():
+                if is_managed_ingress(obj):
+                    shard = ring.shard_for_key(meta_namespace_key(obj))
+                    counts[shard] = counts.get(shard, 0) + 1
+        except Exception:
+            return counts
+        return counts
 
     def drift_tick(self) -> int:
         """Drive ONE drift-resync round explicitly: walk every
@@ -534,6 +642,18 @@ class Manager:
             return {"enabled": False}
         return self.gc.status()
 
+    def queue_status(self) -> dict:
+        """Every controller queue's live internals (ready depth, items
+        being processed, parked delays and the next delay's maturity)
+        — the ``/debug/queues`` view that makes a wedged or
+        delay-parked queue diagnosable from the outside."""
+        status: dict = {}
+        for controller in self.controllers.values():
+            for spec in controller.worker_specs():
+                queue = spec["queue"]
+                status[spec["name"]] = queue.debug_status()
+        return status
+
 
 # ---------------------------------------------------------------------------
 # /healthz + /readyz (stdlib server, the webhook/server.py pattern)
@@ -566,6 +686,9 @@ class _HealthHandler(BaseHTTPRequestHandler):
             return
         if self.path == "/debug/flightrecorder":
             self._flightrecorder()
+            return
+        if self.path == "/debug/queues":
+            self._respond(200, self.server.queue_status())
             return
         self.send_error(404)
 
@@ -673,6 +796,7 @@ def make_health_server(
     shard_status: Optional[Callable[[], dict]] = None,
     slo_status: Optional[Callable[[], dict]] = None,
     fleet_view: Optional["obs_fleet.FleetView"] = None,
+    queue_status: Optional[Callable[[], dict]] = None,
 ) -> ThreadingHTTPServer:
     """Build the manager's health endpoint (bind port 0 in tests);
     call ``serve_forever`` on a daemon thread to serve.  ``gc_status``
@@ -691,6 +815,7 @@ def make_health_server(
     server.stuck_threshold = stuck_threshold
     server.gc_status = gc_status or (lambda: {"enabled": False})
     server.shard_status = shard_status or (lambda: {"enabled": False})
+    server.queue_status = queue_status or (lambda: {})
     server.slo_status = slo_status or obs_slo.status_or_disabled
     server.metrics_registry = (
         metrics_registry if metrics_registry is not None else obs_metrics.registry()

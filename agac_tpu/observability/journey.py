@@ -48,6 +48,10 @@ from .metrics import MetricsRegistry
 TRIGGER_SPEC = "spec"
 TRIGGER_DRIFT = "drift"
 TRIGGER_HANDOFF = "handoff"
+# a live shard-count resize re-homed the key (ISSUE 10): distinct
+# from a failover handoff so resize-driven re-homes get their own
+# convergence histogram series
+TRIGGER_RESIZE = "resize"
 
 # stage names (the stages_total label values)
 STAGE_ENQUEUED = "enqueued"

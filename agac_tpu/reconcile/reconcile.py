@@ -215,6 +215,15 @@ def _reconcile_handler(
         if isinstance(err, api_health.DeadlineExceeded):
             reconcile_metrics.deadline_exceeded.labels(controller=controller).inc()
         _notify(on_sync_result, key, err, queue.num_requeues(key), permanent)
+    elif res.skip:
+        # shard-guard skip (ISSUE 10): the key re-homed to another
+        # replica after it was enqueued here — drop the residue item
+        # without touching its journey (the new owner's resync opened
+        # or will close it) and without any AWS work having run
+        result = instruments.RESULT_SKIPPED
+        queue.forget(key)
+        klog.v(4).infof("Skipped %r: owned by another replica's shards", key)
+        _notify(on_sync_result, key, None, 0, False)
     elif res.requeue_after > 0:
         result = instruments.RESULT_REQUEUE_AFTER
         queue.forget(key)

@@ -14,3 +14,8 @@ from dataclasses import dataclass
 class Result:
     requeue: bool = False
     requeue_after: float = 0.0  # seconds; > 0 wins over ``requeue``
+    # the item was popped but belongs to another replica's shards
+    # (ISSUE 10: a key re-homed between enqueue and pop — queue
+    # residue across a drain/handoff or a lease steal): forget it
+    # WITHOUT closing its journey — the new owner's resync carries it
+    skip: bool = False

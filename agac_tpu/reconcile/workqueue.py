@@ -359,6 +359,25 @@ class RateLimitingQueue:
             _, _, item = heapq.heappop(self._waiting)
             self._add_locked(item)
 
+    def debug_status(self) -> dict:
+        """A point-in-time dump of the queue's internals for
+        ``/debug/queues`` (ISSUE 10): ready/processing/dirty depths,
+        parked delay count and how far away the nearest delay is —
+        enough to tell a wedged worker pool from a backoff park from a
+        genuinely drained queue."""
+        with self._mutex:
+            now = self._clock()
+            return {
+                "ready": len(self._queue),
+                "processing": sorted(map(str, self._processing)),
+                "dirty": len(self._dirty),
+                "delayed": len(self._waiting),
+                "next_delay_in_s": (
+                    round(self._waiting[0][0] - now, 3) if self._waiting else None
+                ),
+                "shutting_down": self._shutting_down,
+            }
+
     def _waiting_loop(self) -> None:
         with self._mutex:
             while not self._shutting_down:

@@ -17,20 +17,40 @@ Three pieces, composed by the manager:
 
 Swift (arxiv 2501.19051) is the reference shape: an elastic control
 plane that scales out without serializing through one coordinator.
+
+Elastic resharding (ISSUE 10) makes the shard count itself a live,
+drain/handoff-mediated target: ``request_resize`` CAS-writes the ring
+lease, every membership tick advances the two-phase transition, and
+``transition_plan`` is the exact donor/gainer movement plan both
+sides coordinate on.
 """
 
 from .membership import (
     OWNS_ALL,
+    RESIZE_ADOPTING,
+    RESIZE_DRAINING,
+    RESIZE_STABLE,
     ShardFilter,
     ShardMembership,
     ShardingConfig,
+    request_resize,
+    resize_in_flight,
+    ring_lease_name,
 )
-from .ring import HashRing
+from .ring import HashRing, RingTransition, transition_plan
 
 __all__ = [
     "HashRing",
     "OWNS_ALL",
+    "RESIZE_ADOPTING",
+    "RESIZE_DRAINING",
+    "RESIZE_STABLE",
+    "RingTransition",
     "ShardFilter",
     "ShardMembership",
     "ShardingConfig",
+    "request_resize",
+    "resize_in_flight",
+    "ring_lease_name",
+    "transition_plan",
 ]

@@ -69,7 +69,12 @@ class HashRing:
         """The owning shard of a ``namespace/name`` reconcile key."""
         if self.shard_count == 1:
             return 0
-        index = bisect.bisect_right(self._points, _point(key))
+        return self.shard_at(_point(key))
+
+    def shard_at(self, position: int) -> int:
+        """The shard owning a raw 64-bit ring position (the arc-scan
+        primitive ``transition_plan`` walks both rings with)."""
+        index = bisect.bisect_right(self._points, position)
         if index == len(self._points):
             index = 0  # wrap: past the last vnode belongs to the first
         return self._shards[index]
@@ -83,3 +88,77 @@ class HashRing:
         for key in keys:
             buckets[self.shard_for_key(key)].append(key)
         return buckets
+
+
+# ---------------------------------------------------------------------------
+# ring transitions (ISSUE 10): the exact donor/gainer plan of a resize
+# ---------------------------------------------------------------------------
+
+_RING_SPACE = 1 << 64
+
+
+class RingTransition:
+    """The exact movement plan between two rings, computed over the
+    union of both rings' vnode boundaries (no sampling): for every arc
+    segment whose owner differs, the old owner is a *donor* of keys to
+    the new-ring *gainer*.  Because surviving shards keep their vnode
+    identities, growth re-homes only the arcs the new shards' vnodes
+    capture (~1/N of the circle) and shrink re-homes only the removed
+    shards' arcs — the bound the property tier pins."""
+
+    __slots__ = ("old", "new", "moved_fraction", "gainers_of", "donors_of")
+
+    def __init__(self, old: "HashRing", new: "HashRing"):
+        self.old = old
+        self.new = new
+        # donor shard -> set of gainer shards it donates arcs to
+        self.gainers_of: dict[int, frozenset[int]] = {}
+        # gainer shard -> set of donor shards it receives arcs from
+        self.donors_of: dict[int, frozenset[int]] = {}
+        gainers_of: dict[int, set[int]] = {}
+        donors_of: dict[int, set[int]] = {}
+        boundaries = sorted(set(old._points) | set(new._points))
+        moved = 0
+        for index, start in enumerate(boundaries):
+            end = (
+                boundaries[index + 1]
+                if index + 1 < len(boundaries)
+                else boundaries[0] + _RING_SPACE
+            )
+            # the arc [start, end) belongs, in each ring, to the first
+            # vnode strictly past ``start`` (shard_at semantics)
+            owner_old = old.shard_at(start)
+            owner_new = new.shard_at(start)
+            if owner_old == owner_new:
+                continue
+            moved += end - start
+            gainers_of.setdefault(owner_old, set()).add(owner_new)
+            donors_of.setdefault(owner_new, set()).add(owner_old)
+        self.moved_fraction = moved / _RING_SPACE
+        self.gainers_of = {
+            donor: frozenset(gainers) for donor, gainers in gainers_of.items()
+        }
+        self.donors_of = {
+            gainer: frozenset(donors) for gainer, donors in donors_of.items()
+        }
+
+    @property
+    def donors(self) -> frozenset[int]:
+        return frozenset(self.gainers_of)
+
+    @property
+    def gainers(self) -> frozenset[int]:
+        return frozenset(self.donors_of)
+
+    def key_moves(self, key: str) -> bool:
+        return self.old.shard_for_key(key) != self.new.shard_for_key(key)
+
+
+def transition_plan(old: HashRing, new: HashRing) -> RingTransition:
+    """The movement plan of an ``old`` → ``new`` ring transition."""
+    if old.vnodes != new.vnodes:
+        raise ValueError(
+            f"rings must share vnode count ({old.vnodes} != {new.vnodes}): "
+            "surviving-vnode identity is what bounds movement"
+        )
+    return RingTransition(old, new)

@@ -51,6 +51,7 @@ from .harness import SimHarness, SimHarnessConfig
 from .oracles import (
     CircuitBudgetOracle,
     GCDeletionOracle,
+    check_resize_handoffs,
     check_slo,
     standard_oracles,
 )
@@ -331,6 +332,124 @@ def run_scenario(
         racecheck.disable()
 
 
+def run_resize_scenario(
+    seed: int,
+    profile: str = "mini",
+    no_faults: bool = False,
+) -> ScenarioResult:
+    """The resize-under-faults canary (ISSUE 10): a sharded fleet
+    (2 shards, 3 replicas) churns while a mid-run live resize to 4
+    shards is composed with replica death (kill -9 semantics: the
+    closest sharded analog of leader churn) and a seeded service
+    brownout.  Oracles armed: the standard battery PLUS key-level
+    exclusive ownership through the transition and the handoff-window
+    oracle — and the scenario itself asserts the transition COMPLETED
+    despite the faults (a wedged resize is a failure even when nothing
+    else broke)."""
+    shape = PROFILES[profile]
+    rng = random.Random(seed)
+    config = SimHarnessConfig(
+        replicas=3,
+        shard_count=2,
+        shards_per_replica=4,
+        resync_period=600.0,
+        drift_tick_period=900.0,
+        # the GC sweeper mops up deletes whose events died with a
+        # killed replica or landed in a handoff gap — the same
+        # level-triggered safety net the standard scenario runs
+        gc_sweep_period=450.0,
+        gc_grace_sweeps=2,
+        health=HealthConfig(
+            window=30.0,
+            min_calls=6,
+            failure_ratio=0.5,
+            open_duration=15.0,
+            probe_budget=1,
+            aimd_qps=50.0,
+        ),
+        lease=_fast_lease(),
+    )
+    watchdog = racecheck.enable()
+    try:
+        with SimHarness(config=config) as harness:
+            for slot in range(shape.service_slots):
+                harness.aws.add_load_balancer(
+                    f"lb{slot}", "us-west-2", _nlb_hostname(slot)
+                )
+            harness.aws.add_hosted_zone("example.com")
+            harness.run_for(15.0)  # membership + initial sync
+            harness.spawn(_churn_actor(harness, rng, shape), "churn")
+            resize_at = rng.uniform(0.25, 0.45) * shape.active_seconds
+            harness.after(
+                resize_at, lambda: harness.request_resize(4), "resize-to-4"
+            )
+            if not no_faults:
+                # replica death composed INTO the transition window
+                kill_at = resize_at + rng.uniform(
+                    0.0, 3 * config.lease.retry_period
+                )
+                harness.after(
+                    kill_at,
+                    lambda: harness.kill_shard_replica(replace=True),
+                    "kill-replica-mid-resize",
+                )
+                service = rng.choice(sorted(_SERVICE_OPS))
+                window = rng.uniform(60.0, 180.0)
+                _schedule_brownout(
+                    harness,
+                    resize_at + rng.uniform(0.0, 60.0),
+                    service,
+                    window,
+                    [],
+                )
+            harness.run_for(shape.active_seconds)
+            harness.fault_plan.restore()
+            harness.fault_plan.refill(0)
+            quiesced = harness.run_until_quiescent(
+                shape.heal_seconds, settle_window=3 * 60.0
+            )
+            # orphans whose delete events died with a killed replica
+            # (or in a handoff gap) clear only through GC grace — give
+            # the sweeper its grace_sweeps+1 intervals, then re-settle
+            harness.run_for(3 * 450.0)
+            quiesced = quiesced and harness.run_until_quiescent(
+                shape.heal_seconds, settle_window=3 * 60.0
+            )
+            violations = list(harness.violations)
+            if not quiesced:
+                violations.append(
+                    "quiescence: world still busy after "
+                    f"{shape.heal_seconds}s virtual heal window"
+                )
+            violations += standard_oracles(harness, config.cluster_name)
+            if not harness.resize_settled(4):
+                violations.append(
+                    f"resize: fleet never settled at 4 shards under faults: "
+                    f"{harness.resize_states()}"
+                )
+            try:
+                watchdog.assert_clean()
+            except AssertionError as err:
+                violations.append(f"racecheck: {err}")
+            stats = harness.stats()
+            stats["resize"] = {
+                identity: status
+                for identity, status in harness.resize_states().items()
+            }
+            stats["handoff_violations"] = check_resize_handoffs(harness)
+            return ScenarioResult(
+                seed=seed,
+                profile=profile,
+                canary="resize",
+                trace_hash=harness.trace_hash(),
+                violations=violations,
+                stats=stats,
+                trace_tail=list(harness.scheduler.trace_tail)[-200:],
+            )
+    finally:
+        racecheck.disable()
+
+
 def _fast_lease():
     from ..leaderelection import LeaderElectionConfig
 
@@ -518,6 +637,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--profile", default="quick", choices=sorted(PROFILES))
     parser.add_argument("--canary", default=None, choices=CANARIES)
     parser.add_argument(
+        "--scenario", default="standard", choices=("standard", "resize"),
+        help="'resize' plays the sharded resize-under-faults scenario "
+        "(live 2→4 resize composed with replica death + brownout, "
+        "key-level ownership and handoff oracles armed) instead of the "
+        "single-leader churn scenario",
+    )
+    parser.add_argument(
         "--no-faults", action="store_true",
         help="churn only, no fault compositions — ARMS the "
         "convergence-SLO oracle (a fault-free run missing an "
@@ -528,10 +654,15 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     failures = 0
     for seed in [int(s) for s in args.seeds.split(",") if s]:
-        result = run_scenario(
-            seed, profile=args.profile, canary=args.canary,
-            no_faults=args.no_faults,
-        )
+        if args.scenario == "resize":
+            result = run_resize_scenario(
+                seed, profile=args.profile, no_faults=args.no_faults
+            )
+        else:
+            result = run_scenario(
+                seed, profile=args.profile, canary=args.canary,
+                no_faults=args.no_faults,
+            )
         status = "ok" if result.ok else "FAIL"
         print(
             f"seed {seed} [{args.profile}] {status} "

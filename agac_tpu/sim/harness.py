@@ -146,6 +146,12 @@ class SimHarnessConfig:
     # sustained burn actually defer GC sweeps / drift ticks
     slo_eval_interval: float = 15.0
     slo_shed_gates: bool = False
+    # elastic resharding (ISSUE 10): the longest a moving key may sit
+    # unowned between its donor's drain and its gainer's adoption
+    # before the handoff oracle flags it; 0 = 4 lease retry periods
+    # (drain starts only once the adopter is standing by, so the gap
+    # is bounded by tick interleaving, not lease expiry)
+    handoff_window_budget: float = 0.0
 
 
 class _World:
@@ -508,6 +514,12 @@ class SimHarness:
         self._pumping = False
         self.generations = 0  # stacks built (leadership acquisitions)
         self.violations: list[str] = []
+        # elastic resharding (ISSUE 10): unowned-window tracking of
+        # moving keys across a drain/handoff, and the violations the
+        # check_resize_handoffs oracle surfaces
+        self.handoff_violations: list[str] = []
+        self._unowned_since: dict[str, float] = {}
+        self._resize_requests: list[int] = []
         # hooks the fuzzer uses: called around every GC sweep so
         # continuous oracles can snapshot ownership immediately before
         # the sweep and attribute each deletion to it precisely
@@ -726,12 +738,53 @@ class SimHarness:
             for replica in self.live_replicas()
         }
 
+    def request_resize(self, target_count: int) -> int:
+        """The live-resize verb (ISSUE 10): CAS the new shard-count
+        target onto the ring lease; every replica's next membership
+        tick begins the drain/handoff transition.  The key-level
+        exclusive-ownership oracle arms itself for the transition."""
+        from ..sharding import request_resize as _request_resize
+
+        epoch = _request_resize(self.cluster, target_count)
+        self._resize_requests.append(target_count)
+        self.scheduler.record("resize", f"target:{target_count}@e{epoch}")
+        return epoch
+
+    def resize_states(self) -> dict[str, dict]:
+        """Per-replica resize status (assertion surface)."""
+        return {
+            replica.identity: (
+                replica.stack.manager.shard_membership.resize_status()
+            )
+            for replica in self.live_replicas()
+        }
+
+    def resize_settled(self, target_count: int) -> bool:
+        """True once every live replica's membership runs the stable
+        target-count ring with no handoffs pending."""
+        for status in self.resize_states().values():
+            if (
+                status["state"] != "stable"
+                or status["shard_count"] != target_count
+                or status["handoff_pending"]
+            ):
+                return False
+        return True
+
     def check_exclusive_ownership(self) -> None:
         """The no-key-owned-by-two-shards oracle, continuous form:
         called after every membership tick; any overlap between two
         LIVE replicas' owned sets is appended to ``violations``.
         (A dead replica's stale leases are unowned keyspace, not an
-        overlap — nobody enqueues for them until a survivor steals.)"""
+        overlap — nobody enqueues for them until a survivor steals.)
+
+        During a live resize (ISSUE 10) shard indices are not the
+        whole truth — a moving key's EFFECTIVE owner depends on the
+        drain/handoff state — so the check drops to key granularity:
+        every managed key must have at most one live owner through the
+        whole transition, and a moving key's unowned window (donor
+        drained, gainer not yet adopted) must stay within the handoff
+        budget while both sides are alive."""
         ownership = sorted(self.shard_ownership().items())
         for i, (id_a, owned_a) in enumerate(ownership):
             for id_b, owned_b in ownership[i + 1:]:
@@ -742,6 +795,87 @@ class SimHarness:
                         f"by BOTH {id_a} and {id_b} at "
                         f"t={self.scheduler.monotonic():.1f}"
                     )
+        transitioning = any(
+            replica.stack.manager.shard_membership.next_ring is not None
+            for replica in self.live_replicas()
+        )
+        if transitioning or self._unowned_since:
+            self._check_key_ownership(transitioning)
+
+    # keys beyond which the key-level sweep would dominate the tick
+    # (transitions in huge fleets fall back to the shard-set check)
+    _KEY_ORACLE_CAP = 10_000
+
+    def _handoff_budget(self) -> float:
+        if self.config.handoff_window_budget > 0:
+            return self.config.handoff_window_budget
+        return 4.0 * self.config.lease.retry_period
+
+    def _check_key_ownership(self, transitioning: bool) -> None:
+        from ..controllers.globalaccelerator import is_managed_service
+        from ..cluster.objects import meta_namespace_key
+
+        live = self.live_replicas()
+        live_identities = {replica.identity for replica in live}
+        services, _ = self.cluster.list("Service")
+        managed = [
+            meta_namespace_key(svc) for svc in services if is_managed_service(svc)
+        ]
+        if len(managed) > self._KEY_ORACLE_CAP:
+            return
+        now = self.scheduler.monotonic()
+        budget = self._handoff_budget()
+        for key in managed:
+            owners = [
+                replica.identity
+                for replica in live
+                if replica.stack.manager.shard_filter.owns_key(key)
+            ]
+            if len(owners) > 1:
+                self.violations.append(
+                    f"exclusive-ownership: key {key!r} owned by "
+                    f"{sorted(owners)} at t={now:.1f}"
+                )
+                self._unowned_since.pop(key, None)
+            elif owners:
+                since = self._unowned_since.pop(key, None)
+                if since is not None and now - since > budget:
+                    self.handoff_violations.append(
+                        f"handoff-window: key {key!r} unowned for "
+                        f"{now - since:.1f}s (budget {budget:.1f}s)"
+                    )
+            else:
+                # unowned: only a PROTOCOL gap counts against the
+                # handoff budget — a dead holder's keyspace waits for
+                # the lease steal, which is failover latency, not a
+                # drain/handoff defect
+                if transitioning and self._key_holders_live(key, live_identities):
+                    self._unowned_since.setdefault(key, now)
+                else:
+                    self._unowned_since.pop(key, None)
+
+    def _key_holders_live(self, key: str, live_identities: set) -> bool:
+        """True when every lease the key's handoff depends on — its
+        old-ring shard, its new-ring shard, and every OTHER donor the
+        gainer's adoption waits for — is held by a LIVE replica: the
+        case where an unowned window is the protocol's own latency.
+        A dead holder anywhere in that dependency set turns the window
+        into failover latency (bounded by the lease steal, not the
+        handoff budget), so the clock stops."""
+        for replica in self.live_replicas():
+            membership = replica.stack.manager.shard_membership
+            if membership.next_ring is None or membership.plan is None:
+                continue
+            s_old = membership.ring.shard_for_key(key)
+            s_new = membership.next_ring.shard_for_key(key)
+            holders = membership.shard_map()["holders"]
+            involved = {s_old, s_new}
+            involved.update(membership.plan.donors_of.get(s_new, ()))
+            return all(
+                holders.get(str(shard)) in live_identities
+                for shard in involved
+            )
+        return False
 
     # ------------------------------------------------------------------
     # leadership

@@ -210,6 +210,28 @@ def check_exclusive_shard_ownership(harness) -> list[str]:
     return violations
 
 
+def check_resize_handoffs(harness) -> list[str]:
+    """The live-resize handoff oracle (ISSUE 10): every moving key's
+    unowned window stayed within the handoff budget while both sides
+    of its handoff were alive, no transition is still in flight at
+    quiescence, and every live replica settled on the same ring."""
+    violations = list(getattr(harness, "handoff_violations", ()))
+    states = harness.resize_states()
+    for identity, status in sorted(states.items()):
+        if status["state"] != "stable" or status["handoff_pending"]:
+            violations.append(
+                f"resize: {identity} still {status['state']} "
+                f"({status['handoff_pending']} handoffs pending) at quiescence"
+            )
+    rings = {status["ring"] for status in states.values()}
+    if len(rings) > 1:
+        violations.append(
+            f"resize: live replicas disagree on the ring at quiescence: "
+            f"{sorted(rings)}"
+        )
+    return violations
+
+
 def check_slo(harness) -> list[str]:
     """The convergence-SLO oracle (ISSUE 9): every declared objective's
     CUMULATIVE good fraction over the whole scenario meets its target.
@@ -234,6 +256,7 @@ def standard_oracles(harness, cluster_name: str = "default") -> list[str]:
     )
     if getattr(harness, "_sharded", False):
         violations += check_exclusive_shard_ownership(harness)
+        violations += check_resize_handoffs(harness)
     return violations
 
 

@@ -1240,19 +1240,33 @@ def run_drift_tick(n: int, workers: int) -> dict:
 # fleet size of the multi-process phase; the CI smoke test shrinks it
 # (speedup is only asserted at >= SHARD_GATE_MIN_N — tiny fleets are
 # dominated by process startup, not throughput)
-SHARD_N = int(os.environ.get("AGAC_BENCH_SHARD_N", "150"))
+SHARD_N = int(os.environ.get("AGAC_BENCH_SHARD_N", "200"))
 SHARD_WORKERS = int(os.environ.get("AGAC_BENCH_SHARD_WORKERS", "8"))
 # per-call wire latency shaping the subprocesses (AGAC_FAKE_LATENCY):
 # throughput is then bound by each process's worker pool x latency —
-# the per-process capacity model sharding divides.  0.15 s ~ the
-# real-world GA mutate p50 band.
-SHARD_LATENCY = float(os.environ.get("AGAC_BENCH_SHARD_LATENCY", "0.15"))
+# the per-process capacity model sharding divides.  0.3 s sits in the
+# real-world GA mutate p50 band (0.15 undershot it and turned the
+# 4/8-shard points CPU-bound on shared-core hosts, measuring the
+# bench host instead of the architecture).
+SHARD_LATENCY = float(os.environ.get("AGAC_BENCH_SHARD_LATENCY", "0.3"))
 # the global per-service AWS budget (calls/s): each replica's AIMD
 # ceiling is budget x owned/shard_count, so the fleet aggregate can
 # never exceed it — asserted from measured call rates below
 SHARD_BUDGET_QPS = float(os.environ.get("AGAC_BENCH_SHARD_BUDGET", "400"))
 SHARD_MIN_SPEEDUP = 1.7
 SHARD_GATE_MIN_N = 100
+# the scaling-curve sweep (ISSUE 10): shard widths measured over real
+# subprocesses; the CI smoke shrinks this to "1,2".  Width 1 anchors
+# the curve; every width's fleet AIMD-ceiling sum and aggregate call
+# rate is asserted within the global budget.
+SHARD_WIDTHS = tuple(
+    int(w)
+    for w in os.environ.get("AGAC_BENCH_SHARD_WIDTHS", "1,2,4,8").split(",")
+    if w.strip()
+)
+# the 4-shard efficiency gate: aggregate >= 0.75 x (4 x single-shard)
+# — i.e. >= 3.0x the single-shard headline (acceptance, ISSUE 10)
+SHARD_MIN_EFFICIENCY_4 = 0.75
 
 SHARD_LB_NAME = "shardlb"
 SHARD_LB_HOSTNAME = "shardlb-0123456789abcdef.elb.us-west-2.amazonaws.com"
@@ -1368,11 +1382,14 @@ def _run_shard_fleet(shard_count: int, replicas: int, n: int) -> dict:
             AGAC_FAKE_QUOTA_ACCELERATORS=str(n + 20),
             POD_NAMESPACE="kube-system",
             AGAC_API_HEALTH_AIMD_QPS=str(SHARD_BUDGET_QPS),
-            # failover-grade lease timing (sub-5s takeover) that still
-            # tolerates GIL pauses of two busy processes on one core
-            AGAC_LEASE_DURATION="4",
-            AGAC_LEASE_RENEW_DEADLINE="2",
-            AGAC_LEASE_RETRY_PERIOD="0.3",
+            # throughput-grade lease timing: the sweep measures the
+            # scaling curve, not failover (the process drills do), and
+            # at 8 busy python processes on shared cores a sub-2s renew
+            # deadline reads a GIL pause as a crash — the spurious
+            # steal + reshard resync then serializes the whole fleet
+            AGAC_LEASE_DURATION="15",
+            AGAC_LEASE_RENEW_DEADLINE="8",
+            AGAC_LEASE_RETRY_PERIOD="0.5",
             AGAC_ACCELERATOR_MISSING_RETRY="0.1",
             AGAC_LB_NOT_ACTIVE_RETRY="0.1",
             AGAC_POLL_INTERVAL="0.02",
@@ -1420,8 +1437,20 @@ def _run_shard_fleet(shard_count: int, replicas: int, n: int) -> dict:
                 time.sleep(0.2)
 
             t0 = time.monotonic()
-            for i in range(n):
-                client.create("Service", _shard_service(i))
+            # parallel creates: the serial REST loop is width-
+            # independent fixed cost, but at 4-8 shard aggregate
+            # speeds it eats a visible slice of the timed window —
+            # fan it out so the sweep measures the FLEET, not the
+            # bench's own client
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                list(
+                    pool.map(
+                        lambda i: client.create("Service", _shard_service(i)),
+                        range(n),
+                    )
+                )
             aws = FileBackedFakeAWSBackend(state_path)
             while time.monotonic() - t0 < DEADLINE:
                 accelerators, listeners, groups = aws.chain_counts()
@@ -1490,70 +1519,147 @@ def _run_shard_fleet(shard_count: int, replicas: int, n: int) -> dict:
     }
 
 
+def _assert_run_within_budget(run: dict) -> None:
+    """The quota-division contract at ONE width: the fleet AGGREGATE
+    never exceeds the global per-service budget — in measured call
+    rates AND in the structural sum of the live replicas' AIMD
+    ceilings."""
+    width = run["shard_count"]
+    for service, rate in run["aggregate_calls_per_sec_by_service"].items():
+        if rate > SHARD_BUDGET_QPS * 1.001:
+            raise SystemExit(
+                f"sharding phase ({width} shards): aggregate {service} call "
+                f"rate {rate}/s exceeds the global budget {SHARD_BUDGET_QPS}/s"
+            )
+    ceiling_sums: dict[str, float] = {}
+    for replica in run["per_replica"]:
+        for service, ceiling in replica["aimd_ceilings"].items():
+            ceiling_sums[service] = ceiling_sums.get(service, 0.0) + ceiling
+    for service, total in ceiling_sums.items():
+        if total > SHARD_BUDGET_QPS * 1.001:
+            raise SystemExit(
+                f"sharding phase ({width} shards): summed {service} AIMD "
+                f"ceilings {total}/s exceed the global budget "
+                f"{SHARD_BUDGET_QPS}/s — quota division is broken"
+            )
+    run["aimd_ceiling_sums"] = {
+        service: round(total, 2) for service, total in sorted(ceiling_sums.items())
+    }
+
+
+def _filter_overhead_ns(width: int, keys: list) -> float:
+    """Median-ish per-lookup cost of the memoized ShardFilter at one
+    width (warm memo — the steady-state enqueue/drift/GC gate cost)."""
+    from agac_tpu.sharding import HashRing, ShardFilter
+
+    owned = frozenset(range(max(1, width // 2)))
+    shard_filter = ShardFilter(HashRing(width), lambda: owned)
+    for key in keys:  # warm the memo: the ring walk happens HERE
+        shard_filter.owns_key(key)
+    best = float("inf")
+    for _ in range(3):
+        start = time.perf_counter()
+        for key in keys:
+            shard_filter.owns_key(key)
+        best = min(best, time.perf_counter() - start)
+    return best * 1e9 / len(keys)
+
+
 def run_sharding_phase() -> dict:
-    """The 2-shard multi-process phase: single-shard headline first,
-    then two concurrently-live sharded replicas over the same durable
-    account — asserting the quota-division invariant (aggregate call
-    rate and summed AIMD ceilings within the global budget) and, at
-    full scale, the >= 1.7x scale-out bar."""
-    _progress(
-        f"sharding: single-shard headline over {SHARD_N} services "
-        f"({SHARD_WORKERS} workers, {SHARD_LATENCY:g}s call latency)"
-    )
-    single = _run_shard_fleet(1, 1, SHARD_N)
-    _progress(
-        f"sharding: single {single['objects_per_sec']} objects/s in "
-        f"{single['elapsed_s']}s"
-    )
-    _progress("sharding: 2-shard fleet (2 live replicas, divided quota)")
-    sharded = _run_shard_fleet(2, 2, SHARD_N)
-    _progress(
-        f"sharding: 2-shard aggregate {sharded['objects_per_sec']} objects/s "
-        f"in {sharded['elapsed_s']}s"
-    )
-    speedup = round(
-        sharded["objects_per_sec"] / max(single["objects_per_sec"], 1e-9), 2
-    )
+    """The scaling-curve sweep (ISSUE 10): converge the same fleet at
+    every width in SHARD_WIDTHS (default 1/2/4/8) over real controller
+    subprocesses sharing one flock-arbitrated durable account.  Every
+    width asserts the quota-division invariant (aggregate call rate
+    and summed AIMD ceilings within the global budget); at full scale
+    the curve is gated — 2-shard aggregate >= 1.7x single, 4-shard
+    efficiency >= 0.75 (i.e. >= 3.0x single).  A memoized-filter
+    micro-benchmark asserts the ownership-gate cost stays flat across
+    widths."""
+    if 1 not in SHARD_WIDTHS:
+        raise SystemExit("sharding sweep needs width 1 (the curve's anchor)")
+    runs: dict[int, dict] = {}
+    for width in SHARD_WIDTHS:
+        _progress(
+            f"sharding: {width}-shard fleet over {SHARD_N} services "
+            f"({width} replicas x {SHARD_WORKERS} workers, "
+            f"{SHARD_LATENCY:g}s call latency)"
+        )
+        runs[width] = _run_shard_fleet(width, width, SHARD_N)
+        _progress(
+            f"sharding: {width}-shard aggregate "
+            f"{runs[width]['objects_per_sec']} objects/s in "
+            f"{runs[width]['elapsed_s']}s"
+        )
+        _assert_run_within_budget(runs[width])
+    single = runs[1]
+    sweep: dict[str, dict] = {}
+    for width, run in sorted(runs.items()):
+        efficiency = round(
+            run["objects_per_sec"]
+            / max(width * single["objects_per_sec"], 1e-9),
+            3,
+        )
+        sweep[str(width)] = {
+            "objects_per_sec": run["objects_per_sec"],
+            "elapsed_s": run["elapsed_s"],
+            "speedup": round(
+                run["objects_per_sec"] / max(single["objects_per_sec"], 1e-9), 2
+            ),
+            "efficiency": efficiency,
+            "aimd_ceiling_sums": run["aimd_ceiling_sums"],
+            "ga_converge_p99_s": run["convergence"]["ga"]["p99_s"],
+        }
+    # the memoized ShardFilter micro-assert (ISSUE 10 satellite): the
+    # ownership gate's steady-state cost must not grow with width —
+    # a dict hit either way, pinned here so a regression to per-call
+    # ring walks shows up in the bench, not in production profiles
+    micro_keys = [f"ns{i % 10}/bench-{i:05d}" for i in range(2000)]
+    filter_overhead = {
+        str(width): round(_filter_overhead_ns(width, micro_keys), 1)
+        for width in sorted(runs)
+    }
+    overheads = list(filter_overhead.values())
+    if max(overheads) > 6 * max(min(overheads), 0.001) and max(overheads) > 2000:
+        raise SystemExit(
+            f"sharding phase: memoized filter overhead is not flat across "
+            f"widths: {filter_overhead} ns/lookup"
+        )
+    speedup = sweep.get("2", {}).get("speedup", 0.0)
     phase = {
         "single": single,
-        "sharded": sharded,
+        # the 2-shard run keeps its dedicated block (the PR 8 output
+        # contract); the full curve lives in "sweep"
+        "sharded": runs.get(2, single),
         "speedup": speedup,
+        "sweep": sweep,
+        "widths": sorted(runs),
+        "filter_overhead_ns_by_width": filter_overhead,
         "quota_budget_per_service_qps": SHARD_BUDGET_QPS,
         "workers_per_replica": SHARD_WORKERS,
         "call_latency_s": SHARD_LATENCY,
         "note": (
             "real controller subprocesses over one flock-arbitrated durable "
             "fake account; per-process capacity = workers x call latency, "
-            "divided AIMD budget = global x owned/shard_count"
+            "divided AIMD budget = global x owned/shard_count; efficiency = "
+            "aggregate / (width x single)"
         ),
     }
-    # the quota-division contract: the fleet AGGREGATE never exceeds
-    # the global per-service budget — in measured call rates AND in the
-    # structural sum of the live replicas' AIMD ceilings
-    for run in (single, sharded):
-        for service, rate in run["aggregate_calls_per_sec_by_service"].items():
-            if rate > SHARD_BUDGET_QPS * 1.001:
-                raise SystemExit(
-                    f"sharding phase: aggregate {service} call rate "
-                    f"{rate}/s exceeds the global budget {SHARD_BUDGET_QPS}/s"
-                )
-    ceiling_sums: dict[str, float] = {}
-    for replica in sharded["per_replica"]:
-        for service, ceiling in replica["aimd_ceilings"].items():
-            ceiling_sums[service] = ceiling_sums.get(service, 0.0) + ceiling
-    for service, total in ceiling_sums.items():
-        if total > SHARD_BUDGET_QPS * 1.001:
+    if SHARD_N >= SHARD_GATE_MIN_N:
+        if 2 in runs and speedup < SHARD_MIN_SPEEDUP:
             raise SystemExit(
-                f"sharding phase: summed {service} AIMD ceilings {total}/s "
-                f"exceed the global budget {SHARD_BUDGET_QPS}/s — quota "
-                "division is broken"
+                f"sharding phase: 2-shard aggregate is only {speedup}x the "
+                f"single-shard headline (bar: {SHARD_MIN_SPEEDUP}x) — see "
+                "bench_detail.json sharding block"
             )
-    if SHARD_N >= SHARD_GATE_MIN_N and speedup < SHARD_MIN_SPEEDUP:
-        raise SystemExit(
-            f"sharding phase: 2-shard aggregate is only {speedup}x the "
-            f"single-shard headline (bar: {SHARD_MIN_SPEEDUP}x) — see "
-            "bench_detail.json sharding block"
-        )
+        if 4 in runs and sweep["4"]["efficiency"] < SHARD_MIN_EFFICIENCY_4:
+            raise SystemExit(
+                f"sharding phase: 4-shard efficiency "
+                f"{sweep['4']['efficiency']} below the "
+                f"{SHARD_MIN_EFFICIENCY_4} gate "
+                f"({sweep['4']['objects_per_sec']} vs "
+                f"{single['objects_per_sec']} objects/s single) — see "
+                "bench_detail.json sharding.sweep"
+            )
     return phase
 
 
@@ -1635,9 +1741,11 @@ def main():
     # runs last — its processes must not share this process's registry
     sharding = run_sharding_phase()
     _progress(
-        f"sharding: speedup {sharding['speedup']}x "
-        f"({sharding['sharded']['objects_per_sec']} vs "
-        f"{sharding['single']['objects_per_sec']} objects/s)"
+        "sharding: curve "
+        + ", ".join(
+            f"{width}x={block['objects_per_sec']}/s (eff {block['efficiency']})"
+            for width, block in sharding["sweep"].items()
+        )
     )
 
     steady = tuned.pop("steady_state")
@@ -1708,10 +1816,17 @@ def main():
             "derived_s_scaled": drift["derived_tick_seconds_scaled"],
             "derived_s_real": drift["derived_tick_seconds_real_quotas"],
         },
-        # scale-out at a glance: 2-shard aggregate vs single-shard
+        # scale-out at a glance: the 1/2/4/8 curve (ISSUE 10) — per-
+        # width aggregate objs/s, plus the 2-shard speedup and 4-shard
+        # efficiency the gates pin
         "sharding": {
             "speedup": sharding["speedup"],
             "agg_objs_per_sec": sharding["sharded"]["objects_per_sec"],
+            "sweep_objs_per_sec": {
+                width: block["objects_per_sec"]
+                for width, block in sharding["sweep"].items()
+            },
+            "efficiency_4": sharding["sweep"].get("4", {}).get("efficiency"),
         },
         # fleet-merged convergence SLO signals (ISSUE 9): per-kind
         # journey p99 of the tuned phase (through the fleet-merge

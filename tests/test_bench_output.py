@@ -33,11 +33,13 @@ TINY_ENV = {
     "AGAC_BENCH_WORKERS": "4",
     "AGAC_BENCH_STEADY_WINDOW": "0.5",
     "AGAC_BENCH_DRIFT_N": "12",
-    # sharding phase (ISSUE 8): tiny fleet + light latency shaping so
-    # the two subprocess runs finish in seconds; the 1.7x speedup gate
-    # only arms at full scale (>= 100 objects)
+    # sharding phase (ISSUE 8/10): tiny fleet + light latency shaping
+    # + a two-point sweep so the subprocess runs finish in seconds;
+    # the speedup/efficiency gates only arm at full scale (>= 100
+    # objects) and the full 1/2/4/8 curve is the committed bench's job
     "AGAC_BENCH_SHARD_N": "10",
     "AGAC_BENCH_SHARD_LATENCY": "0.05",
+    "AGAC_BENCH_SHARD_WIDTHS": "1,2",
 }
 
 
@@ -214,6 +216,32 @@ def test_sharding_block_exported_and_quota_respected(bench_run, detail_path):
     headline = json.loads(lines[-1])
     assert headline["sharding"]["speedup"] == sharding["speedup"]
     assert headline["convergence"]["fleet_sharded_ga_p99_s"] == merged["p99_s"]
+    # the scaling-curve sweep (ISSUE 10): one block per measured
+    # width, each with throughput, efficiency vs (width x single),
+    # per-width AIMD ceiling sums within the global budget, and a
+    # fleet-merged convergence p99 — plus the memoized-filter
+    # micro-benchmark staying flat across widths
+    sweep = sharding["sweep"]
+    assert set(sweep) == {"1", "2"}  # the smoke's two-point curve
+    budget = sharding["quota_budget_per_service_qps"]
+    for width, block in sweep.items():
+        for key in (
+            "objects_per_sec", "speedup", "efficiency",
+            "aimd_ceiling_sums", "ga_converge_p99_s",
+        ):
+            assert key in block, f"sweep[{width}] missing {key!r}"
+        assert block["objects_per_sec"] > 0
+        for service, total in block["aimd_ceiling_sums"].items():
+            assert total <= budget * 1.001, (
+                f"width {width}: {service} ceilings {total} over {budget}"
+            )
+    assert sweep["1"]["efficiency"] == 1.0
+    overheads = sharding["filter_overhead_ns_by_width"]
+    assert set(overheads) == {"1", "2"}
+    assert all(ns > 0 for ns in overheads.values())
+    assert headline["sharding"]["sweep_objs_per_sec"] == {
+        width: block["objects_per_sec"] for width, block in sweep.items()
+    }
 
 
 def test_metrics_snapshot_scraped_per_phase(bench_run, detail_path):

@@ -722,3 +722,176 @@ class TestTwoShardProcessDrill:
                         )
             finally:
                 drill.stop_all()
+
+
+class TestElasticResizeProcessDrill:
+    def test_live_resize_2_to_4_then_kill_mid_shrink(self, tmp_path):
+        """The elastic resharding drill (ISSUE 10), over REAL
+        controller processes and the multi-writer durable fake:
+
+        1. two replicas at --shard-count 2 converge a fleet; /healthz
+           reports the sharding.resize block stable on ring 2x64;
+        2. the `resize-shards` CLI CAS-writes the ring lease; both
+           replicas drain/handoff to 4 shards with NO restart — every
+           new-ring lease held, resize state back to `stable`, ring
+           4x64, zero handoffs pending, and keys created DURING the
+           transition converge;
+        3. a second resize (back to 2) starts and one replica is
+           kill -9'd mid-transition: the survivor steals the dead
+           replica's leases, completes the transition alone, and the
+           durable AWS state shows no duplicate accelerators and no
+           lost keys."""
+        n = 6
+        with TestApiServer() as server:
+            drill = Drill(tmp_path, server)
+            ports = [free_port(), free_port()]
+            procs = []
+            try:
+                for port in ports:
+                    procs.append(
+                        drill.start(
+                            args=(
+                                "--shard-count", "2",
+                                "--shards-per-replica", "4",
+                                "--health-port", str(port),
+                            ),
+                            leader_election=True,
+                            extra_env=SHARD_LEASE_ENV,
+                        )
+                    )
+
+                def views():
+                    result = [healthz_sharding(port) for port in ports]
+                    if any(v is None or not v.get("enabled") for v in result):
+                        return None
+                    return result
+
+                def all_held(expected: set):
+                    current = views()
+                    if current is None:
+                        return False
+                    owned = [set(v["owned"]) for v in current]
+                    return set().union(*owned) == expected
+
+                assert wait_until(
+                    lambda: all_held({0, 1}), timeout=30.0
+                ), _dump(procs[0]) + _dump(procs[1])
+                for view in views():
+                    assert view["resize"]["state"] == "stable"
+                    assert view["resize"]["ring"] == "2x64"
+                    assert view["resize"]["handoff_pending"] == 0
+
+                for i in range(n):
+                    drill.client.create(
+                        "Service", make_lb_service(name=f"svc-{i:02d}")
+                    )
+
+                def chains_complete(expected):
+                    accelerators, listeners, groups = drill.aws().chain_counts()
+                    return accelerators == listeners == groups == expected
+
+                assert wait_until(lambda: chains_complete(n), timeout=60.0), (
+                    f"fleet did not converge: {drill.aws().chain_counts()}"
+                )
+
+                # ------------------------------------------------------
+                # live resize 2 -> 4 through the CLI (the operator's
+                # entry point), with keys landing mid-transition
+                # ------------------------------------------------------
+                resize = subprocess.run(
+                    [
+                        sys.executable, "-m", "agac_tpu", "resize-shards",
+                        "-n", "4",
+                        "--kubeconfig", str(drill.kubeconfig_path),
+                    ],
+                    capture_output=True, text=True, timeout=30,
+                    cwd=REPO, env=dict(os.environ, POD_NAMESPACE="kube-system"),
+                )
+                assert resize.returncode == 0, resize.stderr
+                assert "epoch 1" in resize.stdout
+
+                for i in range(n, n + 3):
+                    drill.client.create(
+                        "Service", make_lb_service(name=f"svc-{i:02d}")
+                    )
+
+                def resized_to(count, expected_ring):
+                    current = views()
+                    if current is None:
+                        return False
+                    return all(
+                        v["resize"]["state"] == "stable"
+                        and v["resize"]["ring"] == expected_ring
+                        and v["resize"]["handoff_pending"] == 0
+                        and v["resize"]["shard_count"] == count
+                        for v in current
+                    ) and all_held(set(range(count)))
+
+                assert wait_until(
+                    lambda: resized_to(4, "4x64"), timeout=45.0
+                ), [healthz_sharding(port) for port in ports]
+                # the resize bumped the epoch everywhere and keys kept
+                # converging THROUGH the transition
+                for view in views():
+                    assert view["resize"]["epoch"] == 1
+                assert wait_until(
+                    lambda: chains_complete(n + 3), timeout=60.0
+                ), f"mid-resize keys lost: {drill.aws().chain_counts()}"
+                # exclusive ownership at the process level, post-resize
+                owned = [set(v["owned"]) for v in views()]
+                assert owned[0] & owned[1] == set(), owned
+
+                # ------------------------------------------------------
+                # kill -9 DURING an in-flight resize (4 -> 2): the
+                # survivor completes the transition alone
+                # ------------------------------------------------------
+                resize = subprocess.run(
+                    [
+                        sys.executable, "-m", "agac_tpu", "resize-shards",
+                        "-n", "2",
+                        "--kubeconfig", str(drill.kubeconfig_path),
+                    ],
+                    capture_output=True, text=True, timeout=30,
+                    cwd=REPO, env=dict(os.environ, POD_NAMESPACE="kube-system"),
+                )
+                assert resize.returncode == 0, resize.stderr
+                victim = 0
+                survivor_port = ports[1]
+                procs[victim].kill()
+                procs[victim].wait(10)
+
+                def survivor_resized():
+                    view = healthz_sharding(survivor_port)
+                    return (
+                        view is not None
+                        and view["resize"]["state"] == "stable"
+                        and view["resize"]["ring"] == "2x64"
+                        and view["resize"]["shard_count"] == 2
+                        and view["resize"]["handoff_pending"] == 0
+                        and set(view["owned"]) == {0, 1}
+                    )
+
+                assert wait_until(survivor_resized, timeout=45.0), (
+                    healthz_sharding(survivor_port)
+                )
+                # no duplicate accelerators, no lost keys: one complete
+                # chain per service, each owner exactly once
+                assert wait_until(
+                    lambda: chains_complete(n + 3), timeout=60.0
+                ), f"post-kill state diverged: {drill.aws().chain_counts()}"
+                owners = [
+                    owner
+                    for owner in drill.aws().accelerator_owners().values()
+                    if owner is not None
+                ]
+                assert len(owners) == len(set(owners)) == n + 3, owners
+                # and a key created after the dust settles converges on
+                # the survivor alone
+                drill.client.create(
+                    "Service", make_lb_service(name="svc-final")
+                )
+                assert wait_until(
+                    lambda: chains_complete(n + 4), timeout=60.0
+                ), f"post-resize key lost: {drill.aws().chain_counts()}"
+            finally:
+                drill.stop_all()

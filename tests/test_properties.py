@@ -51,6 +51,7 @@ from agac_tpu.cloudprovider.aws.sigv4 import Credentials, sign_request
 from agac_tpu.cluster.objects import ObjectMeta
 from agac_tpu.cluster.serde import from_wire, to_wire
 from agac_tpu.reconcile import RateLimitingQueue
+from agac_tpu.sharding import HashRing, transition_plan
 
 # ---------------------------------------------------------------------------
 # serde round trip
@@ -337,3 +338,79 @@ def test_replace_wildcards_replaces_at_most_first_escape(s):
     assert out.count("\\052") == max(0, s.count("\\052") - 1)
     if "\\052" not in s:
         assert out == s
+
+
+# ---------------------------------------------------------------------------
+# elastic ring resize (ISSUE 10): movement bounds, vnode identity,
+# post-resize balance — the properties the drain/handoff protocol's
+# cost model is built on
+# ---------------------------------------------------------------------------
+
+# the resize path the rollout runbook walks: grow 1→2→4→8, scale back
+# to 4 — every step's movement must stay consistent-hashing-bounded
+RESIZE_CHAIN = (1, 2, 4, 8, 4)
+
+
+@given(st.integers(min_value=0, max_value=2**31 - 1))
+@settings(max_examples=25, deadline=None)
+def test_resize_chain_movement_bounded_by_one_nth_plus_slack(seed):
+    keys = [f"ns{seed % 7}/svc-{seed}-{i:05d}" for i in range(600)]
+    for old_count, new_count in zip(RESIZE_CHAIN, RESIZE_CHAIN[1:]):
+        old, new = HashRing(old_count), HashRing(new_count)
+        moved = sum(
+            1 for k in keys if old.shard_for_key(k) != new.shard_for_key(k)
+        )
+        if new_count > old_count:
+            # growth: ideal movement is (new-old)/new of the keyspace
+            ideal = (new_count - old_count) / new_count
+        else:
+            # shrink: the removed shards' arcs move, (old-new)/old
+            ideal = (old_count - new_count) / old_count
+        # vnode-placement variance + finite sample slack; a modulo
+        # partitioner would move ~(1 - 1/max) and blow this bound
+        assert moved / len(keys) <= ideal + 0.2, (
+            f"{old_count}->{new_count} moved {moved}/{len(keys)} "
+            f"(ideal {ideal:.2f})"
+        )
+        # and the exact arc measure stays consistent-hash bounded too
+        plan = transition_plan(old, new)
+        assert plan.moved_fraction <= ideal + 0.15
+
+
+@given(
+    st.integers(min_value=1, max_value=12),
+    st.integers(min_value=1, max_value=12),
+    st.text(alphabet="abcdefghij0123456789-", min_size=1, max_size=24),
+)
+@settings(max_examples=60, deadline=None)
+def test_surviving_vnode_identity_pins_non_moving_keys(old_count, new_count, name):
+    """A key whose shard SURVIVES the resize and does not fall in a
+    re-captured arc keeps its shard index: surviving vnodes are
+    identical points on both rings, so ownership is stable unless the
+    transition plan says the key's arc moved."""
+    assume(old_count != new_count)
+    old, new = HashRing(old_count), HashRing(new_count)
+    plan = transition_plan(old, new)
+    key = f"default/{name}"
+    if not plan.key_moves(key):
+        assert old.shard_for_key(key) == new.shard_for_key(key)
+    else:
+        s_old, s_new = old.shard_for_key(key), new.shard_for_key(key)
+        assert s_new in plan.gainers_of[s_old]
+
+
+@given(st.sampled_from([2, 3, 4, 5, 8]))
+@settings(max_examples=10, deadline=None)
+def test_post_resize_distribution_stays_balanced(new_count):
+    """After any resize in the chain, the max/min shard-load ratio of
+    the NEW ring stays bounded — a transition never leaves a pathological
+    hot shard behind."""
+    keys = [f"default/svc-{i:05d}" for i in range(4000)]
+    ring = HashRing(new_count)
+    buckets = ring.partition(keys)
+    sizes = [len(owned) for owned in buckets.values()]
+    assert min(sizes) > 0
+    fair = len(keys) / new_count
+    assert max(sizes) <= 1.7 * fair
+    assert min(sizes) >= 0.45 * fair
+    assert max(sizes) / min(sizes) <= 3.2

@@ -43,6 +43,12 @@ from agac_tpu.sharding import (
     ShardFilter,
     ShardMembership,
     ShardingConfig,
+    request_resize,
+    transition_plan,
+)
+from agac_tpu.sharding.membership import (
+    ANN_KEYS_OWNED,
+    RESIZE_STABLE,
 )
 from agac_tpu.sharding.reports import merge_shard_reports
 
@@ -104,6 +110,50 @@ class TestHashRing:
 
 
 # ---------------------------------------------------------------------------
+# ring transitions (ISSUE 10): the exact donor/gainer movement plan
+# ---------------------------------------------------------------------------
+
+
+class TestRingTransition:
+    def test_identical_rings_move_nothing(self):
+        plan = transition_plan(HashRing(4), HashRing(4))
+        assert plan.moved_fraction == 0.0
+        assert plan.gainers == frozenset()
+        assert plan.donors == frozenset()
+
+    def test_growth_gainers_are_exactly_the_new_shards(self):
+        plan = transition_plan(HashRing(2), HashRing(4))
+        # surviving shards keep their vnodes, so only the NEW shards
+        # capture arcs on growth
+        assert plan.gainers == {2, 3}
+        assert plan.donors <= {0, 1}
+        assert 0 < plan.moved_fraction < 0.75
+
+    def test_shrink_donors_are_exactly_the_removed_shards(self):
+        plan = transition_plan(HashRing(4), HashRing(2))
+        assert plan.donors == {2, 3}
+        assert plan.gainers <= {0, 1}
+
+    def test_plan_agrees_with_per_key_movement(self):
+        old, new = HashRing(3), HashRing(5)
+        plan = transition_plan(old, new)
+        keys = [f"default/svc-{i:05d}" for i in range(4000)]
+        for key in keys:
+            s_old, s_new = old.shard_for_key(key), new.shard_for_key(key)
+            assert plan.key_moves(key) == (s_old != s_new)
+            if s_old != s_new:
+                assert s_new in plan.gainers_of[s_old]
+                assert s_old in plan.donors_of[s_new]
+        measured = sum(plan.key_moves(k) for k in keys) / len(keys)
+        # the sampled movement tracks the exact arc measure
+        assert abs(measured - plan.moved_fraction) < 0.05
+
+    def test_vnode_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            transition_plan(HashRing(2, vnodes=32), HashRing(4, vnodes=64))
+
+
+# ---------------------------------------------------------------------------
 # filter
 # ---------------------------------------------------------------------------
 
@@ -145,13 +195,14 @@ class MembershipWorld:
     explicitly on a fake clock — the cooperative form the sim harness
     schedules, without a scheduler."""
 
-    def __init__(self, shard_count=2, capacity=2, replicas=("a", "b")):
+    def __init__(self, shard_count=2, capacity=2, replicas=("a", "b"), **config_overrides):
         self.cluster = FakeCluster()
         self.now = 0.0
         config = ShardingConfig(
             shard_count=shard_count,
             shards_per_replica=capacity,
             lease=FAST_LEASE,
+            **config_overrides,
         )
         self.members = {
             identity: ShardMembership(
@@ -163,6 +214,15 @@ class MembershipWorld:
     def tick(self, *identities):
         for identity in identities or self.members:
             self.members[identity].tick(self.cluster)
+
+    def full_tick(self, *identities):
+        """tick + the manager's resize role: run the (out-of-band)
+        resync and ack adoptions — what ``Manager.shard_tick`` does."""
+        for identity in identities or self.members:
+            member = self.members[identity]
+            member.tick(self.cluster)
+            if member.resync_pending():
+                member.ack_adoptions(self.cluster)
 
     def advance(self, seconds: float):
         self.now += seconds
@@ -178,6 +238,17 @@ class MembershipWorld:
                     f"shard {shard} owned by both {seen[shard]} and {identity}"
                 )
                 seen[shard] = identity
+
+    def assert_key_exclusive(self, keys):
+        """Key-level effective-ownership exclusivity — must hold at
+        EVERY step of a transition, not just the endpoints."""
+        for key in keys:
+            owners = [
+                identity
+                for identity, member in self.members.items()
+                if member.filter.owns_key(key)
+            ]
+            assert len(owners) <= 1, f"key {key} owned by {owners}"
 
 
 class TestShardMembership:
@@ -281,6 +352,321 @@ class TestShardMembership:
         member.tick(cluster)
         member.tick(cluster)  # no further change once both are held
         assert changes == [[0], [0, 1]]
+
+
+# ---------------------------------------------------------------------------
+# elastic resharding (ISSUE 10): the drain/handoff state machine on a
+# fake clock
+# ---------------------------------------------------------------------------
+
+
+SAMPLE_KEYS = [f"default/svc-{i:04d}" for i in range(120)]
+
+
+def settle_resize(world, target, max_ticks=40):
+    """Tick every member (with the manager's ack role) until all run
+    the stable target ring, asserting key-level exclusivity at EVERY
+    step; returns ticks taken."""
+    for tick in range(max_ticks):
+        world.full_tick()
+        world.assert_exclusive()
+        world.assert_key_exclusive(SAMPLE_KEYS)
+        if all(
+            member.resize_status()["state"] == RESIZE_STABLE
+            and member.shard_count == target
+            and not member.resize_status()["handoff_pending"]
+            for member in world.members.values()
+        ):
+            return tick
+        world.advance(FAST_LEASE.retry_period)
+    raise AssertionError(
+        f"resize to {target} never settled: "
+        f"{[m.resize_status() for m in world.members.values()]}"
+    )
+
+
+class TestElasticResize:
+    def test_grow_2_to_4_two_phase_drain_then_adopt(self):
+        world = MembershipWorld(capacity=4)
+        world.tick("a", "b")
+        assert world.owned("a") == {0} and world.owned("b") == {1}
+        epoch = request_resize(world.cluster, 4)
+        assert epoch == 1
+        settle_resize(world, 4)
+        # every shard of the new ring held, split across both replicas
+        held = world.owned("a") | world.owned("b")
+        assert held == {0, 1, 2, 3}
+        world.assert_exclusive()
+        # every key owned by exactly one replica post-resize
+        owners = [
+            sum(m.filter.owns_key(k) for m in world.members.values())
+            for k in SAMPLE_KEYS
+        ]
+        assert all(count == 1 for count in owners)
+        for member in world.members.values():
+            assert member.ring.version == "4x64"
+            assert member.resizes_completed == 1
+
+    def test_no_key_double_owned_and_unowned_bounded_during_transition(self):
+        world = MembershipWorld(capacity=4)
+        world.tick("a", "b")
+        request_resize(world.cluster, 4)
+        unowned_streak = {key: 0 for key in SAMPLE_KEYS}
+        worst = 0
+        for _ in range(40):
+            world.full_tick()
+            world.assert_key_exclusive(SAMPLE_KEYS)
+            for key in SAMPLE_KEYS:
+                owned = any(
+                    m.filter.owns_key(key) for m in world.members.values()
+                )
+                unowned_streak[key] = 0 if owned else unowned_streak[key] + 1
+                worst = max(worst, unowned_streak[key])
+            if all(
+                m.resize_status()["state"] == RESIZE_STABLE
+                and m.shard_count == 4
+                for m in world.members.values()
+            ):
+                break
+            world.advance(FAST_LEASE.retry_period)
+        # with both sides live the drain→adopt gap is tick-bounded:
+        # one handoff window, never a lease expiry
+        assert 0 < worst <= 4, worst
+        assert all(streak == 0 for streak in unowned_streak.values())
+
+    def test_shrink_4_to_2_releases_obsolete_leases(self):
+        world = MembershipWorld(shard_count=4, capacity=4)
+        for _ in range(4):
+            world.tick("a", "b")
+            world.advance(FAST_LEASE.retry_period)
+        assert world.owned("a") | world.owned("b") == {0, 1, 2, 3}
+        request_resize(world.cluster, 2)
+        settle_resize(world, 2)
+        held = world.owned("a") | world.owned("b")
+        assert held == {0, 1}
+        # the obsolete leases were RELEASED, not abandoned: unheld on
+        # the cluster record
+        for shard in (2, 3):
+            lease = world.cluster.get("Lease", "kube-system", f"agac-shard-{shard}")
+            assert not lease.spec.holder_identity
+
+    def test_resize_request_refused_while_in_flight(self):
+        world = MembershipWorld(capacity=4)
+        world.tick("a", "b")
+        request_resize(world.cluster, 4)
+        world.full_tick()  # transition armed, not complete
+        with pytest.raises(RuntimeError, match="in flight"):
+            request_resize(world.cluster, 8)
+        settle_resize(world, 4)
+        # once complete, the next resize is accepted
+        assert request_resize(world.cluster, 2) == 2
+
+    def test_resize_is_idempotent_at_current_count(self):
+        world = MembershipWorld(capacity=4)
+        world.tick("a", "b")
+        epoch = request_resize(world.cluster, 4)
+        settle_resize(world, 4)
+        assert request_resize(world.cluster, 4) == epoch
+
+    def test_dead_donor_mid_resize_survivor_completes(self):
+        """kill -9 semantics during an in-flight resize: b stops
+        ticking after the transition starts (its leases stay held);
+        a steals them after expiry, self-drains/adopts, and COMPLETES
+        the transition alone."""
+        world = MembershipWorld(capacity=4)
+        world.tick("a", "b")
+        request_resize(world.cluster, 4)
+        world.full_tick("a", "b")  # both observe the transition
+        # b dies here; a keeps ticking
+        for _ in range(int(FAST_LEASE.lease_duration) + 30):
+            world.full_tick("a")
+            # only a's view may be asserted — b is "dead" but its
+            # stale membership object still holds python state
+            member = world.members["a"]
+            world.advance(1.0)
+            if (
+                member.resize_status()["state"] == RESIZE_STABLE
+                and member.shard_count == 4
+            ):
+                break
+        member = world.members["a"]
+        assert member.shard_count == 4
+        assert member.resize_status()["state"] == RESIZE_STABLE
+        assert world.owned("a") == {0, 1, 2, 3}
+        assert member.resizes_completed == 1
+
+    def test_resize_status_shape_through_transition(self):
+        world = MembershipWorld(capacity=4)
+        world.tick("a", "b")
+        status = world.members["a"].resize_status()
+        assert status["state"] == RESIZE_STABLE
+        assert status["handoff_pending"] == 0
+        assert status["ring"] == "2x64"
+        request_resize(world.cluster, 4)
+        world.full_tick()
+        status = world.members["a"].resize_status()
+        assert status["state"] in ("draining", "adopting")
+        assert status["from"] == 2 and status["to"] == 4
+        assert status["target_ring"] == "4x64"
+        assert status["handoff_pending"] >= 1
+        assert "pending_gainers" in status and "drained" in status
+        settle_resize(world, 4)
+        final = world.members["a"].resize_status()
+        assert final["state"] == RESIZE_STABLE
+        assert final["ring"] == "4x64"
+        assert final["handoff_pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# load-aware preferred-owner placement (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+class TestLoadAwarePlacement:
+    def wire_counts(self, world, counts: dict[int, int]):
+        for member in world.members.values():
+            member.fleet_key_counts = lambda c=counts: dict(c)
+
+    def test_claims_prefer_the_heaviest_unclaimed_shard(self):
+        world = MembershipWorld(shard_count=4, capacity=4, replicas=("a",))
+        self.wire_counts(world, {0: 1, 1: 9, 2: 5, 3: 7})
+        order = []
+        for _ in range(4):
+            before = world.owned("a")
+            world.tick("a")
+            gained = world.owned("a") - before
+            order.extend(sorted(gained))
+            world.advance(FAST_LEASE.retry_period)
+        assert order == [1, 3, 2, 0], order
+
+    def test_overloaded_replica_abstains_until_availability_grace(self):
+        world = MembershipWorld(
+            shard_count=3, capacity=3, replicas=("a", "b"),
+            rebalance_hysteresis_keys=2, unheld_grace_ticks=3,
+        )
+        self.wire_counts(world, {0: 20, 1: 1, 2: 1})
+        world.tick("a")  # a claims 0 (heaviest), publishing load 20
+        world.tick("b")  # b claims 1
+        assert world.owned("a") == {0} and world.owned("b") == {1}
+        # a is far heavier than b: a must leave shard 2 for b even
+        # while below capacity...
+        world.advance(FAST_LEASE.retry_period)
+        world.tick("a")
+        assert world.owned("a") == {0}, "overloaded replica must abstain"
+        # ...but if nobody claims it past the grace, availability wins
+        for _ in range(4):
+            world.advance(FAST_LEASE.retry_period)
+            world.tick("a")
+        assert world.owned("a") == {0, 2}
+
+    def test_shed_converges_and_does_not_oscillate(self):
+        world = MembershipWorld(
+            shard_count=4, capacity=4, replicas=("a", "b"),
+            rebalance_hysteresis_keys=3, rebalance_cooldown_ticks=3,
+        )
+        counts = {0: 10, 1: 10, 2: 1, 3: 1}
+        self.wire_counts(world, counts)
+        # a vacuums everything before b joins
+        for _ in range(4):
+            world.tick("a")
+            world.advance(FAST_LEASE.retry_period)
+        assert world.owned("a") == {0, 1, 2, 3}
+        # b joins: a (load 22) sheds toward b (load 0); track the
+        # handover count to prove convergence without oscillation
+        transfers = 0
+        previous = {"a": world.owned("a"), "b": world.owned("b")}
+        for _ in range(40):
+            world.tick("a", "b")
+            world.assert_exclusive()
+            current = {"a": world.owned("a"), "b": world.owned("b")}
+            if current != previous:
+                transfers += 1
+                previous = current
+            world.advance(FAST_LEASE.retry_period)
+        load = {
+            identity: sum(counts[s] for s in world.owned(identity))
+            for identity in ("a", "b")
+        }
+        # balanced within the hysteresis, and the system SETTLED (a
+        # bounded number of ownership changes, not a ping-pong)
+        assert abs(load["a"] - load["b"]) <= 3 + max(counts.values()), load
+        assert world.owned("b"), "b must have received load"
+        assert transfers <= 8, f"placement oscillated: {transfers} changes"
+        # a never re-claims what it shed within the cooldown: final
+        # state stays stable over further ticks
+        stable = {"a": world.owned("a"), "b": world.owned("b")}
+        for _ in range(6):
+            world.tick("a", "b")
+            world.advance(FAST_LEASE.retry_period)
+        assert {"a": world.owned("a"), "b": world.owned("b")} == stable
+
+    def test_lease_records_publish_keys_owned(self):
+        world = MembershipWorld(shard_count=2, capacity=2, replicas=("a",))
+        self.wire_counts(world, {0: 7, 1: 3})
+        world.tick("a")
+        world.advance(FAST_LEASE.retry_period)
+        world.tick("a")
+        lease = world.cluster.get("Lease", "kube-system", "agac-shard-0")
+        # a holds both shards by now: published load = 7 or 10
+        # depending on claim order; the annotation must exist and be
+        # an integer
+        assert int(lease.metadata.annotations[ANN_KEYS_OWNED]) >= 7
+
+
+# ---------------------------------------------------------------------------
+# filter memoization (ISSUE 10 satellite): the ring walk runs once per
+# (ring, key)
+# ---------------------------------------------------------------------------
+
+
+class TestFilterMemoization:
+    def test_memo_returns_identical_answers(self):
+        ring = HashRing(4)
+        shard_filter = ShardFilter(ring, lambda: frozenset({1, 2}))
+        keys = [f"default/svc-{i}" for i in range(500)]
+        first = [shard_filter.owns_key(k) for k in keys]
+        second = [shard_filter.owns_key(k) for k in keys]
+        assert first == second
+        assert first == [ring.shard_for_key(k) in {1, 2} for k in keys]
+
+    def test_memo_hits_skip_the_ring_walk(self):
+        ring = HashRing(8)
+        shard_filter = ShardFilter(ring, lambda: frozenset({0}))
+        shard_filter.owns_key("default/hot-key")
+        calls = {"n": 0}
+        original = ring.shard_for_key
+
+        def counting(key):
+            calls["n"] += 1
+            return original(key)
+
+        ring.shard_for_key = counting
+        for _ in range(100):
+            shard_filter.owns_key("default/hot-key")
+        assert calls["n"] == 0, "memoized lookups must not re-walk the ring"
+
+    def test_memo_invalidates_across_ring_versions(self):
+        rings = {"ring": HashRing(2)}
+        shard_filter = ShardFilter(
+            None, lambda: frozenset({0, 1, 2, 3}),
+            ring_provider=lambda: rings["ring"],
+        )
+        key = "default/svc-x"
+        assert shard_filter.owns_key(key)
+        # swap the live ring (a completed resize): lookups must follow
+        # the NEW ring even for memoized keys
+        rings["ring"] = HashRing(8)
+        expected = HashRing(8).shard_for_key(key)
+        shard_filter_owned = ShardFilter(
+            None, lambda: frozenset({expected}),
+            ring_provider=lambda: rings["ring"],
+        )
+        assert shard_filter_owned.owns_key(key)
+        shard_filter_foreign = ShardFilter(
+            None, lambda: frozenset({(expected + 1) % 8}),
+            ring_provider=lambda: rings["ring"],
+        )
+        assert not shard_filter_foreign.owns_key(key)
 
 
 # ---------------------------------------------------------------------------

@@ -28,6 +28,7 @@ from agac_tpu.sim import fuzz
 from agac_tpu.sim.harness import SimHarness, SimHarnessConfig
 from agac_tpu.sim.oracles import (
     check_exclusive_shard_ownership,
+    check_resize_handoffs,
     check_slo,
     standard_oracles,
 )
@@ -254,6 +255,131 @@ class TestShardFailover:
         first, second = run(), run()
         assert first == second
         assert first[1] == 25
+
+
+class TestLiveResize:
+    """The elastic resharding plane (ISSUE 10): a mid-run 2→4 live
+    resize on virtual time — drain/handoff-mediated, exclusive
+    ownership held *throughout*, journeys tracked per re-home."""
+
+    def test_mid_run_2_to_4_resize_converges_under_oracles(self):
+        from agac_tpu.sharding import transition_plan, HashRing
+
+        config = sharded_config(shards_per_replica=4)
+        with SimHarness(config=config) as harness:
+            seed_fleet(harness, 40)
+            converge(harness)
+            assert harness.resize_settled(2)
+            converged_before = harness.journey.converged_total
+            # the live resize: replicas observe the ring lease on
+            # their next membership tick and run the drain/handoff
+            harness.request_resize(4)
+            # spec edits DURING the transition must keep converging
+            for i in range(40, 48):
+                harness.cluster.create(
+                    "Service", make_lb_service(name=f"svc-{i:05d}")
+                )
+            harness.run_for(LEASE.lease_duration + 6 * LEASE.retry_period)
+            assert harness.resize_settled(4), harness.resize_states()
+            converge(harness)
+            # the full battery INCLUDING the key-level exclusive
+            # ownership sweep armed through the transition and the
+            # handoff-window oracle
+            assert standard_oracles(harness) == []
+            assert check_resize_handoffs(harness) == []
+            assert harness.violations == []
+            # every shard of the new ring is held and the fleet is
+            # whole — no duplicates, no lost keys
+            held = sorted(
+                shard
+                for owned in harness.shard_ownership().values()
+                for shard in owned
+            )
+            assert held == [0, 1, 2, 3]
+            assert len(harness.aws.all_accelerator_arns()) == 48
+            # moved-key bound: the 2→4 plan re-homes about half the
+            # ring (2 of 4 shards are new) and NEVER more than the
+            # arc measure + slack — the property tier pins tighter
+            # bounds per step; here the measured fleet must agree
+            plan = transition_plan(HashRing(2), HashRing(4))
+            keys = [f"default/svc-{i:05d}" for i in range(48)]
+            moved = sum(1 for key in keys if plan.key_moves(key))
+            assert moved / len(keys) <= plan.moved_fraction + 0.2
+            # re-homed journeys: the resize resync opened journeys on
+            # the RESIZE trigger and every one of them converged
+            from agac_tpu.observability.metrics import parse_text
+
+            samples = parse_text(harness.fleet_metrics())
+            resize_count = sum(
+                value
+                for name, value in samples.items()
+                if name.startswith("agac_journey_converge_seconds_count")
+                and 'trigger="resize"' in name
+            )
+            assert resize_count >= moved, (
+                f"only {resize_count} resize journeys for {moved} moved keys"
+            )
+            assert harness.journey.inflight() == 0
+            assert harness.journey.converged_total > converged_before
+
+    def test_resize_with_mid_transition_kill_completes(self):
+        """Resize composed with a crash: one replica dies mid-
+        transition (kill -9 semantics — its leases stay held); the
+        survivor steals them, self-drains/adopts, and COMPLETES the
+        transition.  The handoff oracle excuses the dead holder's
+        window (failover latency), but exclusivity must still hold."""
+        config = sharded_config(shards_per_replica=4)
+        with SimHarness(config=config) as harness:
+            seed_fleet(harness, 30)
+            converge(harness)
+            harness.request_resize(4)
+            # let the transition start, then kill one replica
+            harness.run_for(2 * LEASE.retry_period)
+            harness.kill_shard_replica()
+            harness.run_for(2 * (LEASE.lease_duration + 6 * LEASE.retry_period))
+            assert harness.resize_settled(4), harness.resize_states()
+            converge(harness)
+            assert standard_oracles(harness) == []
+            assert harness.violations == []
+            survivor = harness.live_replicas()[0]
+            assert survivor.stack.manager.shard_membership.owned_shards() == (
+                frozenset({0, 1, 2, 3})
+            )
+            assert len(harness.aws.all_accelerator_arns()) == 30
+
+    def test_shrink_4_to_2_releases_obsolete_leases(self):
+        config = sharded_config(
+            shard_count=4, replicas=2, shards_per_replica=4
+        )
+        with SimHarness(config=config) as harness:
+            seed_fleet(harness, 24)
+            converge(harness)
+            harness.request_resize(2)
+            harness.run_for(LEASE.lease_duration + 6 * LEASE.retry_period)
+            assert harness.resize_settled(2), harness.resize_states()
+            converge(harness)
+            assert standard_oracles(harness) == []
+            held = sorted(
+                shard
+                for owned in harness.shard_ownership().values()
+                for shard in owned
+            )
+            assert held == [0, 1], "obsolete leases must be released"
+            assert len(harness.aws.all_accelerator_arns()) == 24
+
+    def test_resize_replay_is_byte_identical(self):
+        def run():
+            config = sharded_config(shards_per_replica=4)
+            with SimHarness(config=config) as harness:
+                seed_fleet(harness, 20)
+                harness.run_for(30.0)
+                harness.request_resize(4)
+                harness.run_until_quiescent(7200.0, settle_window=60.0)
+                return harness.trace_hash(), harness.resize_settled(4)
+
+        first, second = run(), run()
+        assert first == second
+        assert first[1] is True
 
 
 class TestExclusiveOwnershipOracle:
