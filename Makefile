@@ -73,6 +73,16 @@ e2e-kind-smoke:
 lint-invariants:
 	$(PYTHON) -m agac_tpu.analysis.lint agac_tpu tests bench.py
 
+# Whole-program analyses (agac_tpu/analysis/program.py): static
+# lock-order graph + inversion/bare-acquire detection, the
+# shared-mutable-state census (the multi-core refactor's work list),
+# and the determinism audit.  Gates on REGRESSIONS only: findings in
+# analysis_baseline.json are grandfathered with per-finding reasons;
+# a non-empty UNSAFE census bucket or a stale baseline entry fails.
+.PHONY: lint-program
+lint-program:
+	$(PYTHON) -m agac_tpu.analysis.program agac_tpu --report analysis_report.json --baseline analysis_baseline.json
+
 # Regenerate the metric catalog table in docs/operations.md from the
 # live registry (agac_tpu/observability/instruments.py declares every
 # metric); check-metrics-catalog is the CI drift gate.

@@ -18,12 +18,12 @@ to point elsewhere, or ``--installed name,name`` to pin the set.
 from __future__ import annotations
 
 import argparse
-import ast
 import re
 import sys
 from pathlib import Path
 from typing import Iterable, Optional
 
+from .program import ParseCache, ParsedModule, shared_cache
 from .rules import RULES, LintContext, Violation, apply_suppressions
 
 # pip "project name" -> import name, for the handful of deps whose
@@ -75,23 +75,37 @@ def lint_source(
     path: Path,
     ci_installed: frozenset[str],
     first_party: Optional[frozenset[str]] = None,
+    cache: Optional[ParseCache] = None,
 ) -> list[Violation]:
+    cache = shared_cache() if cache is None else cache
     try:
-        tree = ast.parse(source, filename=str(path))
+        parsed = cache.parse(path, source)
     except SyntaxError as err:
         return [
             Violation("syntax-error", str(path), err.lineno or 1, str(err.msg))
         ]
+    return lint_parsed(parsed, ci_installed, first_party)
+
+
+def lint_parsed(
+    parsed: ParsedModule,
+    ci_installed: frozenset[str],
+    first_party: Optional[frozenset[str]] = None,
+) -> list[Violation]:
+    """Run every rule over an already-parsed module.  The context
+    carries the tree, a lazily materialized node list, and the shared
+    import-provenance map — rules no longer re-walk independently."""
     ctx = LintContext(
-        path=path,
-        source_lines=source.splitlines(),
+        path=parsed.path,
+        source_lines=parsed.source_lines,
         ci_installed=ci_installed,
+        tree=parsed.tree,
     )
     if first_party is not None:
         ctx.first_party = first_party
     violations: list[Violation] = []
     for rule in RULES:
-        violations.extend(rule.check(tree, ctx))
+        violations.extend(rule.check(parsed.tree, ctx))
     kept, suppression_errors = apply_suppressions(violations, ctx)
     return sorted(
         kept + suppression_errors, key=lambda v: (v.path, v.line, v.rule)
@@ -102,6 +116,8 @@ def lint_paths(
     targets: Iterable[Path],
     workflows_dir: Optional[Path] = None,
     ci_installed: Optional[frozenset[str]] = None,
+    cache: Optional[ParseCache] = None,
+    jobs: Optional[int] = None,
 ) -> list[Violation]:
     targets = [Path(t) for t in targets]
     if ci_installed is None:
@@ -109,9 +125,26 @@ def lint_paths(
             root = _find_repo_root(targets)
             workflows_dir = root / ".github" / "workflows"
         ci_installed = parse_ci_installed(workflows_dir)
+    cache = shared_cache() if cache is None else cache
+    paths = list(iter_python_files(targets))
+    try:
+        # parallel read+parse into the cache shared with the program
+        # analyses: one ast.parse per file across BOTH runners
+        cache.parse_many(paths, jobs=jobs)
+    except SyntaxError:
+        pass  # surfaced per-file below as a syntax-error violation
     violations: list[Violation] = []
-    for path in iter_python_files(targets):
-        violations.extend(lint_source(path.read_text(), path, ci_installed))
+    for path in paths:
+        parsed = cache.latest(path)
+        if parsed is None:
+            try:
+                parsed = cache.parse(path)
+            except SyntaxError as err:
+                violations.append(
+                    Violation("syntax-error", str(path), err.lineno or 1, str(err.msg))
+                )
+                continue
+        violations.extend(lint_parsed(parsed, ci_installed))
     return violations
 
 

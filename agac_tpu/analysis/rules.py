@@ -84,9 +84,11 @@ from __future__ import annotations
 import ast
 import re
 import sys
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Iterator, Optional
+
+from .program import ImportMap
 
 
 @dataclass(frozen=True)
@@ -110,6 +112,25 @@ class LintContext:
     ci_installed: frozenset[str]
     # top-level import names that belong to this repo
     first_party: frozenset[str] = frozenset({"agac_tpu", "tests", "bench"})
+    # the module tree, set by the driver; rules walk it via walk()
+    tree: Optional[ast.Module] = None
+    imports: Optional[ImportMap] = None
+    _nodes: Optional[list[ast.AST]] = field(default=None, repr=False)
+
+    def walk(self) -> list[ast.AST]:
+        """Materialized ``ast.walk`` of the module, computed once and
+        shared by every rule — previously each of the 13 rules re-walked
+        the tree independently, dominating lint-invariants wall time."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    def import_map(self) -> ImportMap:
+        """Shared import-provenance map (replaces the per-rule import
+        walkers the early rules each grew)."""
+        if self.imports is None:
+            self.imports = ImportMap(self.tree)
+        return self.imports
 
 
 @dataclass(frozen=True)
@@ -172,7 +193,7 @@ def _in_controllers(ctx: LintContext) -> bool:
 def check_raw_backend_call(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
     if not _in_controllers(ctx):
         return
-    for node in ast.walk(tree):
+    for node in ctx.walk():
         if isinstance(node, (ast.Import, ast.ImportFrom)):
             module = getattr(node, "module", "") or ""
             names = [a.name for a in node.names]
@@ -226,7 +247,7 @@ def _terminal_name(node: ast.expr) -> Optional[str]:
     "leaks the lock on exception paths",
 )
 def check_bare_lock_acquire(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
-    for node in ast.walk(tree):
+    for node in ctx.walk():
         if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
             continue
         if node.func.attr not in ("acquire", "release"):
@@ -256,7 +277,7 @@ _RECONCILE_NAME = re.compile(r"^_?(process_|reconcile|sync_)")
     "Result(requeue_after=...) or inject a deadline-bounded sleep seam",
 )
 def check_blocking_reconcile(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
-    for fn in ast.walk(tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if not _RECONCILE_NAME.match(fn.name):
@@ -341,7 +362,7 @@ def _terminates(stmts: list[ast.stmt]) -> bool:
 def check_reconcile_returns_result(
     tree: ast.Module, ctx: LintContext
 ) -> Iterator[Violation]:
-    for fn in ast.walk(tree):
+    for fn in ctx.walk():
         if not isinstance(fn, ast.FunctionDef) or not _returns_result(fn):
             continue
         for node in ast.walk(fn):
@@ -459,14 +480,14 @@ def check_drift_read_outside_read_plane(
     if not _is_aws_driver_module(ctx):
         return
     sanctioned: set[int] = set()  # ids of Call nodes inside sanctioned defs
-    for fn in ast.walk(tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if fn.name in _READ_PLANE_FUNCS:
             for node in ast.walk(fn):
                 if isinstance(node, ast.Call):
                     sanctioned.add(id(node))
-    for node in ast.walk(tree):
+    for node in ctx.walk():
         if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
             continue
         func = node.func
@@ -519,7 +540,7 @@ def check_unbounded_poll_loop(tree: ast.Module, ctx: LintContext) -> Iterator[Vi
     parts = ctx.path.parts
     if "cloudprovider" not in parts and "controllers" not in parts:
         return
-    for node in ast.walk(tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.While):
             continue
         sleeps = any(
@@ -582,7 +603,7 @@ def check_blocking_settle_in_worker(
         return
     if ctx.path.name == "pending.py" and "reconcile" in parts:
         return  # the pending-settle scheduler is the sanctioned home
-    for node in ast.walk(tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.While):
             continue
         sleeps = any(
@@ -648,7 +669,7 @@ def check_delete_without_ownership_check(
 ) -> Iterator[Violation]:
     if not _is_gc_module(ctx):
         return
-    for fn in ast.walk(tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if _OWNERSHIP_VERIFYISH.search(fn.name):
@@ -712,7 +733,7 @@ def check_cross_shard_sweep(
 ) -> Iterator[Violation]:
     if not _is_shard_enumeration_module(ctx):
         return
-    for fn in ast.walk(tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         if not _SHARD_SWEEP_FUNCTIONS.match(fn.name):
@@ -776,7 +797,7 @@ def check_journey_stage_without_stamp(
     the path."""
     if not _is_reconcile_loop_module(ctx):
         return
-    for fn in ast.walk(tree):
+    for fn in ctx.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         moves = [
@@ -824,38 +845,18 @@ _METRIC_CLASSES = frozenset({"Counter", "Gauge", "Histogram", "Metric"})
 _REGISTRY_FACTORIES = frozenset({"counter", "gauge", "histogram"})
 
 
-def _observability_metric_names(tree: ast.Module) -> set[str]:
-    """Local names bound to the observability metric classes (or the
-    metrics module itself), from this module's imports.  Tracking the
-    import provenance keeps ``collections.Counter`` and every other
-    unrelated Counter out of scope."""
-    class_names: set[str] = set()
-    module_names: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            module = node.module or ""
-            from_metrics = module.endswith("observability.metrics") or (
-                node.level > 0 and module.split(".")[-1] == "metrics"
-            )
-            from_observability = module.endswith("observability") or (
-                node.level > 0 and module.split(".")[-1] == "observability"
-            )
-            for alias in node.names:
-                bound = alias.asname or alias.name
-                if from_metrics and alias.name in _METRIC_CLASSES:
-                    class_names.add(bound)
-                elif from_metrics and alias.name == "*":
-                    class_names.update(_METRIC_CLASSES)
-                elif from_observability and alias.name == "metrics":
-                    module_names.add(bound)
-                elif from_observability and alias.name in _METRIC_CLASSES:
-                    class_names.add(bound)
-        elif isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name.endswith("observability.metrics"):
-                    module_names.add(alias.asname or alias.name.split(".")[-1])
-    # attribute access through the module (metrics.Counter) counts too
-    return class_names | {f"{m}.{c}" for m in module_names for c in _METRIC_CLASSES}
+def _metric_class_origin(origin: Optional[str]) -> Optional[str]:
+    """The metric class a call target's import origin denotes, or None.
+    Provenance (via the shared ``ImportMap``) keeps ``collections.
+    Counter`` and every other unrelated Counter out of scope; suffix
+    matching covers both the absolute and relative spellings of the
+    metrics module."""
+    if origin is None:
+        return None
+    for cls in _METRIC_CLASSES:
+        if origin.endswith(f"metrics.{cls}") or origin.endswith(f"observability.{cls}"):
+            return cls
+    return None
 
 
 def _is_metrics_module(ctx: LintContext) -> bool:
@@ -882,21 +883,13 @@ def _literal_str_sequence(node: ast.expr) -> bool:
 def check_unregistered_metric(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
     if _is_metrics_module(ctx):
         return  # the registry module is where the primitives live
-    metric_names = _observability_metric_names(tree)
-    for node in ast.walk(tree):
+    imports = ctx.import_map()
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         func = node.func
         # direct construction: Counter(...) / metrics.Counter(...)
-        called = None
-        if isinstance(func, ast.Name) and func.id in metric_names:
-            called = func.id
-        elif (
-            isinstance(func, ast.Attribute)
-            and isinstance(func.value, ast.Name)
-            and f"{func.value.id}.{func.attr}" in metric_names
-        ):
-            called = f"{func.value.id}.{func.attr}"
+        called = _metric_class_origin(imports.resolve_call_target(func))
         if called is not None:
             yield Violation(
                 "unregistered-metric",
@@ -989,43 +982,21 @@ def _clock_rule_applies(ctx: LintContext) -> bool:
 def check_unseamed_clock(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
     if not _clock_rule_applies(ctx):
         return
-    # names bound by `from time import sleep [as pause]` / `from
-    # threading import Timer [as T]`
-    from_time: dict[str, str] = {}
-    timer_names: set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ImportFrom):
-            continue
-        if node.module == "time":
-            for alias in node.names:
-                if alias.name in _CLOCK_ATTRS:
-                    from_time[alias.asname or alias.name] = alias.name
-        elif node.module == "threading":
-            for alias in node.names:
-                if alias.name == "Timer":
-                    timer_names.add(alias.asname or alias.name)
-    for node in ast.walk(tree):
+    # provenance via the shared ImportMap covers every spelling at
+    # once: `time.sleep`, `import time as _time`, `from time import
+    # sleep as pause`, `from threading import Timer as T`
+    imports = ctx.import_map()
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
-        func = node.func
-        attr = None
-        if (
-            isinstance(func, ast.Attribute)
-            and isinstance(func.value, ast.Name)
-        ):
-            base = func.value.id
-            if base in ("time", "_time") and func.attr in _CLOCK_ATTRS:
-                attr = func.attr
-            elif base == "threading" and func.attr == "Timer":
-                yield _timer_violation(ctx, node)
-                continue
-        elif isinstance(func, ast.Name):
-            if func.id in from_time:
-                attr = from_time[func.id]
-            elif func.id in timer_names:
-                yield _timer_violation(ctx, node)
-                continue
-        if attr is not None:
+        origin = imports.resolve_call_target(node.func)
+        if origin is None:
+            continue
+        if origin == "threading.Timer":
+            yield _timer_violation(ctx, node)
+            continue
+        attr = origin[len("time."):] if origin.startswith("time.") else None
+        if attr in _CLOCK_ATTRS:
             yield Violation(
                 "unseamed-clock",
                 str(ctx.path),

@@ -40,6 +40,7 @@ import threading
 from typing import Callable, Optional
 
 from ... import clockseam, klog
+from ...analysis import racecheck
 from ...observability import instruments
 from .errors import AWSAPIError
 from .types import Change
@@ -132,7 +133,9 @@ class ChangeBatcher:
                 return event.is_set()
 
             self._wait_full = _virtual_wait
-        self._lock = threading.Lock()
+        # racecheck seam: instrumented when the lock-order watchdog is
+        # armed (chaos/soak tiers), a plain Lock otherwise
+        self._lock = racecheck.make_lock("r53-batcher")
         self._forming: dict[str, _ZoneBatch] = {}
         # cumulative counters (stats() / bench export)
         self.batches = 0

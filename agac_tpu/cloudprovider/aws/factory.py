@@ -69,7 +69,7 @@ _change_batcher: ChangeBatcher | None = None
 
 # memoized TTL values (env parsed once per process; a malformed value
 # must not poison every reconcile — fall back and say so once)
-_ttl_values: dict[str, float] = {}
+_ttl_values: dict[str, float] = {}  # agac-lint: ignore[shared-state-census] -- idempotent env memo; racing fills store the same parsed value
 # explicit overrides (CLI flags) beat the environment
 _ttl_overrides: dict[str, float] = {}
 

@@ -23,7 +23,7 @@ from typing import Any, Type, TypeVar, get_args, get_origin, get_type_hints
 
 T = TypeVar("T")
 
-_hints_cache: dict[type, dict[str, Any]] = {}
+_hints_cache: dict[type, dict[str, Any]] = {}  # agac-lint: ignore[shared-state-census] -- idempotent get_type_hints memo; racing writers store identical values
 
 
 def _snake_to_camel(name: str) -> str:

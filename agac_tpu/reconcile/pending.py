@@ -48,6 +48,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .. import clockseam, klog
+from ..analysis import racecheck
 from ..observability import instruments, journey
 
 # what a group poller reports per token
@@ -122,7 +123,9 @@ class PendingSettleTable:
         registry=None,
     ):
         self._clock = clock or clockseam.monotonic
-        self._lock = threading.Lock()
+        # racecheck seam: instrumented when the lock-order watchdog is
+        # armed (chaos/soak tiers), a plain Lock otherwise
+        self._lock = racecheck.make_lock("pending-settle")
         self._groups: dict[str, _GroupState] = {}
         # cumulative counters (stats() / bench export)
         self.parked_total = 0

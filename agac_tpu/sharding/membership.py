@@ -82,6 +82,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .. import klog
+from ..analysis import racecheck
 from ..cluster.objects import Lease, LeaseSpec, ObjectMeta
 from ..errors import AlreadyExistsError, ConflictError, NotFoundError
 from ..leaderelection import LeaderElection, LeaderElectionConfig
@@ -290,7 +291,7 @@ class ShardFilter:
 
 # single-shard mode: one process owns the whole keyspace (the
 # pre-sharding semantics every existing tier runs under)
-OWNS_ALL = ShardFilter(None, lambda: frozenset({0}))
+OWNS_ALL = ShardFilter(None, lambda: frozenset({0}))  # agac-lint: ignore[shared-state-census] -- stateless sentinel; its only mutable is the idempotent shard memo
 
 
 # ---------------------------------------------------------------------------
@@ -405,7 +406,9 @@ class ShardMembership:
         self.ring = HashRing(config.shard_count, config.vnodes)
         self._clock = clock
         self._electors: dict[int, LeaderElection] = {}
-        self._lock = threading.Lock()
+        # racecheck seam: instrumented when the lock-order watchdog is
+        # armed (chaos/soak tiers), a plain Lock otherwise
+        self._lock = racecheck.make_lock("sharding.membership")
         self._owned: frozenset[int] = frozenset()
         # last observed holder per shard (None = unheld/unknown) and a
         # version that bumps whenever the observed assignment changes —

@@ -31,7 +31,7 @@ import urllib.request
 import pytest
 
 from agac_tpu import apis
-from agac_tpu.analysis import racecheck
+from agac_tpu.analysis import lockorder, racecheck
 from agac_tpu.cloudprovider.aws import AWSDriver
 from agac_tpu.cloudprovider.aws.fake_backend import FakeAWSBackend
 from agac_tpu.cloudprovider.aws.health import (
@@ -119,6 +119,11 @@ def _racecheck_watchdog():
     try:
         yield watchdog
         watchdog.assert_clean()
+        # the runtime-observed acquisition order must be a subset of
+        # the static lock graph (ISSUE 12): an uncovered edge means the
+        # whole-program analysis has a call-graph blind spot
+        violations, _ = lockorder.runtime_crosscheck(watchdog.edges())
+        assert not violations, "\n".join(violations)
     finally:
         racecheck.disable()
 

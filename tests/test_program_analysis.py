@@ -1,0 +1,435 @@
+"""Whole-program analysis engine tests (ISSUE 12).
+
+Every analysis must catch a seeded fixture violation it claims to
+catch — a static auditor that silently misses its target class is
+worse than none, because it LOOKS like coverage.  Alongside the
+seeded-violation fixtures: the report-schema golden, the baseline
+round-trip (add finding -> baseline -> gate green -> remove code ->
+stale entry flagged), the runtime cross-check mapping, and the
+single-parse-per-file invariant the lint-invariants wall-time fix is
+pinned on.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from agac_tpu.analysis import census, determinism, lockorder  # noqa: F401  (registers rules)
+from agac_tpu.analysis.lint import lint_paths
+from agac_tpu.analysis.program import (
+    Baseline,
+    ImportMap,
+    ParseCache,
+    Program,
+    build_report,
+    gate_failures,
+    run_analyses,
+)
+from agac_tpu.analysis.program import main as program_main
+
+
+def build_fixture(tmp_path, files: dict[str, str]) -> Program:
+    pkg = tmp_path / "fix"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return Program.build([pkg], ParseCache())
+
+
+# ---------------------------------------------------------------------------
+# lock-order: seeded inversion pair + bare acquire
+# ---------------------------------------------------------------------------
+
+INVERSION_SRC = """
+    import threading
+
+
+    class Pair:
+        def __init__(self):
+            self.first = threading.Lock()
+            self.second = threading.Lock()
+
+        def forward(self):
+            with self.first:
+                with self.second:
+                    pass
+
+        def backward(self):
+            with self.second:
+                self._grab_first()
+
+        def _grab_first(self):
+            # the inversion only exists THROUGH the call graph: backward
+            # holds `second` while this callee acquires `first`
+            with self.first:
+                pass
+"""
+
+
+class TestLockOrder:
+    def test_seeded_inversion_pair_is_caught(self, tmp_path):
+        program = build_fixture(tmp_path, {"pair.py": INVERSION_SRC})
+        _, block, findings = lockorder.build_lock_graph(program)
+        inversions = [f for f in findings if f.rule == "lock-order-inversion"]
+        assert inversions, [f.render() for f in findings]
+        assert "fix.pair.Pair.first" in inversions[0].key
+        assert "fix.pair.Pair.second" in inversions[0].key
+        # both orders appear as static edges
+        edges = {tuple(e) for e in block["edges"]}
+        assert ("fix.pair.Pair.first", "fix.pair.Pair.second") in edges
+        assert ("fix.pair.Pair.second", "fix.pair.Pair.first") in edges
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        src = INVERSION_SRC.replace(
+            "with self.second:\n                self._grab_first()",
+            "with self.first:\n                self._grab_first()",
+        ).replace("with self.first:\n                pass", "pass")
+        program = build_fixture(tmp_path, {"pair.py": src})
+        _, _, findings = lockorder.build_lock_graph(program)
+        assert [f for f in findings if f.rule == "lock-order-inversion"] == []
+
+    def test_bare_acquire_without_finally_is_caught(self, tmp_path):
+        program = build_fixture(
+            tmp_path,
+            {
+                "bare.py": """
+                import threading
+
+
+                class Holder:
+                    def __init__(self):
+                        self.mu = threading.Lock()
+
+                    def leaky(self):
+                        self.mu.acquire()
+                        work = 1
+                        self.mu.release()
+                        return work
+
+                    def safe(self):
+                        self.mu.acquire()
+                        try:
+                            return 1
+                        finally:
+                            self.mu.release()
+                """
+            },
+        )
+        _, _, findings = lockorder.build_lock_graph(program)
+        bare = [f for f in findings if f.rule == "bare-acquire"]
+        assert len(bare) == 1, [f.render() for f in findings]
+        assert "leaky" in bare[0].key
+        assert "safe" not in bare[0].key
+
+    def test_runtime_edge_missing_from_static_graph_is_flagged(self, tmp_path):
+        program = build_fixture(tmp_path, {"pair.py": INVERSION_SRC})
+        index, block, _ = lockorder.build_lock_graph(program)
+        static_edges = {tuple(e) for e in block["edges"]}
+        # rename-free fixture: identities double as runtime names via
+        # their construction-site prefix — fabricate a name the index
+        # cannot map and an edge the graph already covers
+        violations, unmapped = lockorder.unmatched_runtime_edges(
+            index, static_edges, [("not-a-known-lock", "also-unknown")]
+        )
+        assert violations == []
+        assert unmapped == ["not-a-known-lock"]
+
+
+# ---------------------------------------------------------------------------
+# census: unguarded module global mutated from a thread target
+# ---------------------------------------------------------------------------
+
+CENSUS_SRC = """
+    import threading
+
+    EVENTS = []
+
+
+    def worker():
+        EVENTS.append("tick")
+
+
+    def start():
+        threading.Thread(target=worker).start()
+"""
+
+
+class TestCensus:
+    def test_unguarded_global_mutated_from_thread_target_is_unsafe(self, tmp_path):
+        program = build_fixture(tmp_path, {"state.py": CENSUS_SRC})
+        block, findings = census.build_census(program)
+        entry = next(e for e in block["census"] if e["name"] == "fix.state.EVENTS")
+        assert entry["bucket"] == "UNSAFE"
+        assert any(f.rule == "shared-state-census" for f in findings)
+        # the spawn is discovered through the call graph
+        assert "fix.state::worker" in block["thread_roots"]
+
+    def test_lock_guarded_global_is_not_unsafe(self, tmp_path):
+        program = build_fixture(
+            tmp_path,
+            {
+                "state.py": """
+                import threading
+
+                _lock = threading.Lock()
+                EVENTS = []
+
+
+                def worker():
+                    with _lock:
+                        EVENTS.append("tick")
+
+
+                def start():
+                    threading.Thread(target=worker).start()
+                """
+            },
+        )
+        block, _ = census.build_census(program)
+        entry = next(e for e in block["census"] if e["name"] == "fix.state.EVENTS")
+        assert entry["bucket"] == "lock-guarded"
+
+    def test_inline_suppression_moves_entry_out_of_unsafe(self, tmp_path):
+        src = CENSUS_SRC.replace(
+            "EVENTS = []",
+            "EVENTS = []  # agac-lint: ignore[shared-state-census] -- test-only sink",
+        )
+        program = build_fixture(tmp_path, {"state.py": src})
+        block, findings = census.build_census(program)
+        entry = next(e for e in block["census"] if e["name"] == "fix.state.EVENTS")
+        assert entry["bucket"] == "suppressed"
+        assert not any(f.rule == "shared-state-census" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# determinism: set iteration into a trace hash, unseeded random,
+# thread spawn outside the clockseam gate
+# ---------------------------------------------------------------------------
+
+
+class TestDeterminism:
+    def test_set_iteration_into_trace_hash_is_caught(self, tmp_path):
+        program = build_fixture(
+            tmp_path,
+            {
+                "trace.py": """
+                import hashlib
+
+
+                def trace_digest(events):
+                    h = hashlib.sha256()
+                    for item in {repr(e) for e in events}:
+                        h.update(item.encode())
+                    return h.hexdigest()
+
+
+                def sorted_digest(events):
+                    h = hashlib.sha256()
+                    for item in sorted({repr(e) for e in events}):
+                        h.update(item.encode())
+                    return h.hexdigest()
+                """
+            },
+        )
+        findings, _ = determinism.check_determinism(program)
+        keys = {f.key for f in findings if f.rule == "unordered-iteration"}
+        assert any("trace_digest" in k for k in keys), keys
+        assert not any("sorted_digest" in k for k in keys), keys
+
+    def test_unseeded_random_is_caught(self, tmp_path):
+        program = build_fixture(
+            tmp_path,
+            {
+                "jit.py": """
+                import random
+
+
+                def jitter():
+                    return random.random()
+
+
+                def seeded(seed):
+                    return random.Random(seed).random()
+                """
+            },
+        )
+        findings, _ = determinism.check_determinism(program)
+        keys = {f.key for f in findings if f.rule == "unseeded-random"}
+        assert any("::jitter" in k for k in keys), keys
+        assert not any("::seeded" in k for k in keys), keys
+
+    def test_thread_spawn_outside_clockseam_gate_is_caught(self, tmp_path):
+        program = build_fixture(
+            tmp_path,
+            {
+                "spawn.py": """
+                import threading
+
+                from somewhere import threads_enabled
+
+
+                def run():
+                    pass
+
+
+                def ungated():
+                    threading.Thread(target=run).start()
+
+
+                def gated():
+                    if threads_enabled():
+                        threading.Thread(target=run).start()
+                """
+            },
+        )
+        findings, _ = determinism.check_determinism(program)
+        keys = {f.key for f in findings if f.rule == "unseamed-thread"}
+        assert any("::ungated" in k for k in keys), keys
+        assert not any("::gated" in k for k in keys), keys
+
+
+# ---------------------------------------------------------------------------
+# report schema golden + gate
+# ---------------------------------------------------------------------------
+
+
+class TestReportSchema:
+    def test_report_schema(self, tmp_path):
+        program = build_fixture(tmp_path, {"pair.py": INVERSION_SRC})
+        findings, blocks = run_analyses(program)
+        report = build_report(program, findings, blocks, Baseline())
+        assert report["schema"] == 1
+        assert set(report) == {
+            "schema", "generated_by", "modules", "parse",
+            "analyses", "findings", "baseline", "gate",
+        }
+        assert set(report["gate"]) == {
+            "new_findings", "unsafe_census", "stale_baseline", "clean",
+        }
+        assert set(report["baseline"]) == {"entries", "grandfathered", "stale"}
+        assert set(report["analyses"]) == {"lock-order", "census", "determinism"}
+        assert set(report["analyses"]["lock-order"]) == {
+            "locks", "identities", "edges", "findings",
+        }
+        assert set(report["analyses"]["census"]) == {
+            "census", "buckets", "thread_roots",
+        }
+        for f in report["findings"]:
+            assert set(f) == {"analysis", "rule", "path", "line", "key", "message"}
+        for e in report["analyses"]["census"]["census"]:
+            assert set(e) == {
+                "name", "kind", "value_type", "path", "line",
+                "bucket", "reason", "mutations",
+            }
+        json.dumps(report)  # machine-readable end to end
+
+    def test_gate_fails_on_new_finding_and_unsafe_census(self, tmp_path):
+        program = build_fixture(
+            tmp_path, {"pair.py": INVERSION_SRC, "state.py": CENSUS_SRC}
+        )
+        findings, blocks = run_analyses(program)
+        report = build_report(program, findings, blocks, Baseline())
+        assert not report["gate"]["clean"]
+        failures = gate_failures(report)
+        assert any("lock-order-inversion" in f for f in failures)
+        assert any("UNSAFE" in f for f in failures)
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestBaseline:
+    def test_round_trip_then_stale_when_code_removed(self, tmp_path):
+        program = build_fixture(tmp_path, {"pair.py": INVERSION_SRC})
+        findings, blocks = run_analyses(program)
+        assert findings
+        baseline = Baseline(
+            {f.key: "grandfathered: pre-existing fixture debt" for f in findings}
+        )
+        report = build_report(program, findings, blocks, baseline)
+        assert report["gate"]["clean"], gate_failures(report)
+        assert sorted(report["baseline"]["grandfathered"]) == sorted(
+            f.key for f in findings
+        )
+        # remove the offending code: every baseline entry goes stale
+        # and the gate goes red until the entries are dropped
+        clean = build_fixture(tmp_path, {"pair.py": "X = 1\n"})
+        findings2, blocks2 = run_analyses(clean)
+        report2 = build_report(clean, findings2, blocks2, baseline)
+        assert report2["baseline"]["stale"] == sorted(baseline.entries)
+        assert not report2["gate"]["clean"]
+        assert any(
+            "matches no current finding" in f for f in gate_failures(report2)
+        )
+
+    def test_baseline_keys_are_line_number_stable(self, tmp_path):
+        program = build_fixture(tmp_path, {"pair.py": INVERSION_SRC})
+        findings, _ = run_analyses(program)
+        shifted = build_fixture(
+            tmp_path, {"pair.py": "# a comment shifting every line\n" + textwrap.dedent(INVERSION_SRC)}
+        )
+        findings2, _ = run_analyses(shifted)
+        assert {f.key for f in findings} == {f.key for f in findings2}
+
+    def test_save_load_and_reason_mandatory(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        Baseline({"k::1": "because"}).save(path)
+        assert Baseline.load(path).entries == {"k::1": "because"}
+        path.write_text(json.dumps({"findings": [{"key": "k::1", "reason": " "}]}))
+        with pytest.raises(ValueError):
+            Baseline.load(path)
+
+    def test_cli_update_baseline_round_trip(self, tmp_path):
+        pkg = tmp_path / "fix"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "pair.py").write_text(textwrap.dedent(INVERSION_SRC))
+        report = tmp_path / "report.json"
+        baseline = tmp_path / "baseline.json"
+        # red without a baseline, green after --update-baseline
+        assert program_main(
+            [str(pkg), "--report", str(report), "--baseline", str(baseline)]
+        ) == 1
+        assert program_main(
+            [str(pkg), "--report", str(report), "--baseline", str(baseline),
+             "--update-baseline"]
+        ) == 0
+        assert program_main(
+            [str(pkg), "--report", str(report), "--baseline", str(baseline)]
+        ) == 0
+        assert json.loads(report.read_text())["gate"]["clean"]
+
+
+# ---------------------------------------------------------------------------
+# shared parse infra: single parse per file across BOTH runners
+# ---------------------------------------------------------------------------
+
+
+class TestSharedParse:
+    def test_single_parse_per_file_across_lint_and_program(self, tmp_path):
+        pkg = tmp_path / "fix"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "a.py").write_text("import threading\n\nA = threading.Lock()\n")
+        (pkg / "b.py").write_text("def f():\n    return 1\n")
+        cache = ParseCache()
+        lint_paths([pkg], ci_installed=frozenset(), cache=cache)
+        Program.build([pkg], cache)
+        assert cache.parse_counts, "nothing parsed?"
+        assert set(cache.parse_counts.values()) == {1}, cache.parse_counts
+
+    def test_import_map_is_shared_provenance(self, tmp_path):
+        program = build_fixture(
+            tmp_path,
+            {"m.py": "from time import sleep as pause\nimport threading as th\n"},
+        )
+        imports = program.modules["fix.m"].imports
+        assert isinstance(imports, ImportMap)
+        assert imports.resolve("pause") == "time.sleep"
+        assert imports.resolve("th") == "threading"
