@@ -191,6 +191,48 @@ def build_parser() -> argparse.ArgumentParser:
         "SLOs'; /slo serves the live view. 0 disables the engine.",
     )
     controller.add_argument(
+        "--autoscale", action="store_true",
+        help="SLO-driven shard autoscaler (ISSUE 13): close the loop "
+        "from burn rate to live resize. Scales out on sustained "
+        "both-window budget burn or growing oldest-unconverged-age, "
+        "scales in only on sustained headroom, always through the "
+        "drain/handoff resize path — railed by min/max shards, one "
+        "doubling per step, per-direction cooldowns, and never while "
+        "a transition is in flight. Requires --shard-count > 1 and "
+        "the SLO engine (--slo-eval-interval > 0). Every decision is "
+        "flight-recorded; /debug/autoscaler serves the history.",
+    )
+    controller.add_argument(
+        "--autoscale-min-shards", type=int, default=2,
+        help="Floor the autoscaler may never scale below.",
+    )
+    controller.add_argument(
+        "--autoscale-max-shards", type=int, default=8,
+        help="Ceiling the autoscaler may never scale above.",
+    )
+    controller.add_argument(
+        "--autoscale-cooldown-out", type=float, default=120.0,
+        help="Seconds after any executed resize before the next "
+        "scale-OUT may fire (sized to outlast placement hysteresis "
+        "and the transition itself).",
+    )
+    controller.add_argument(
+        "--autoscale-cooldown-in", type=float, default=600.0,
+        help="Seconds after any executed resize before the next "
+        "scale-IN may fire (longer than scale-out: shrinking is the "
+        "cheaper mistake to delay).",
+    )
+    controller.add_argument(
+        "--autoscale-interval", type=float, default=30.0,
+        help="Seconds between autoscaler evaluations.",
+    )
+    controller.add_argument(
+        "--autoscale-observe-only", action="store_true",
+        help="Evaluate and flight-record scale recommendations "
+        "WITHOUT acting — the recommended first rollout step (watch "
+        "/debug/autoscaler before arming).",
+    )
+    controller.add_argument(
         "--fleet-peers", default="",
         help="Comma-separated host:port list of the OTHER shard "
         "replicas' health endpoints. /metrics/fleet on this replica "
@@ -277,6 +319,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--force", action="store_true",
         help="Supersede an in-flight transition (only when the fleet "
         "is wedged — a forced restart recomputes every replica's plan).",
+    )
+    resize.add_argument(
+        "--dry-run", action="store_true",
+        help="Print the computed transition plan (donor/gainer arcs, "
+        "moved keyspace fraction) without writing the ring lease.",
     )
 
     sub.add_parser("version", help="Print the version number")
@@ -446,11 +493,83 @@ def run_controller(args) -> int:
             peer, obs_fleet.http_fetcher(url.rstrip("/") + "/metrics")
         )
 
+    autoscaler = None
+    if args.autoscale:
+        # the shard autoscaler (ISSUE 13): burn rates + journey ages +
+        # the ring-lease load board in, railed resize decisions out
+        # through the same CAS path the resize-shards CLI uses
+        if args.shard_count <= 1:
+            klog.warning(
+                "--autoscale requires --shard-count > 1; autoscaler disabled"
+            )
+        elif args.slo_eval_interval <= 0:
+            klog.warning(
+                "--autoscale requires the SLO engine "
+                "(--slo-eval-interval > 0); autoscaler disabled"
+            )
+        else:
+            from ..autoscaler import (
+                AutoscalerLoop,
+                ScalePolicy,
+                ScalePolicyConfig,
+                ScaleSignals,
+            )
+
+            def _resize_status():
+                membership = manager.shard_membership
+                return (
+                    membership.resize_status() if membership is not None else {}
+                )
+
+            def _replica_count():
+                membership = manager.shard_membership
+                if membership is None:
+                    return 0
+                holders = membership.shard_map().get("holders", {})
+                return len(set(holders.values()))
+
+            autoscaler = AutoscalerLoop(
+                ScaleSignals(
+                    slo_engine=obs_slo.engine(),
+                    journey_tracker=obs_journey.tracker(),
+                    resize_status=_resize_status,
+                    keys_by_shard=manager.keys_by_shard,
+                    replica_count=_replica_count,
+                    open_circuits=(
+                        tracker.open_services if tracker is not None else None
+                    ),
+                ),
+                ScalePolicy(
+                    ScalePolicyConfig(
+                        min_shards=args.autoscale_min_shards,
+                        max_shards=args.autoscale_max_shards,
+                        cooldown_out_seconds=args.autoscale_cooldown_out,
+                        cooldown_in_seconds=args.autoscale_cooldown_in,
+                        observe_only=args.autoscale_observe_only,
+                    )
+                ),
+                execute=lambda target: manager.request_resize(client, target),
+                registry=obs_metrics.registry(),
+            )
+
+            def autoscale_loop():
+                autoscaler.run(stop, args.autoscale_interval)
+
+            threading.Thread(
+                target=autoscale_loop, daemon=True, name="autoscaler"
+            ).start()
+
     if args.health_port > 0:
         health_server = make_health_server(
             args.health_port, health=tracker, gc_status=manager.gc_status,
             shard_status=manager.shard_status, fleet_view=fleet_view,
             queue_status=manager.queue_status,
+            autoscaler_status=(
+                autoscaler.status if autoscaler is not None else None
+            ),
+            autoscaler_history=(
+                autoscaler.history if autoscaler is not None else None
+            ),
         )
         threading.Thread(
             target=health_server.serve_forever, daemon=True, name="health-server"
@@ -505,7 +624,7 @@ def run_controller(args) -> int:
 
 def run_resize_shards(args) -> int:
     from ..cluster.rest import build_client
-    from ..sharding import request_resize
+    from ..sharding import HashRing, request_resize, ring_status, transition_plan
 
     kubeconfig = resolve_kubeconfig(args.kubeconfig)
     try:
@@ -514,6 +633,39 @@ def run_resize_shards(args) -> int:
         klog.errorf("Error building rest config: %s", err)
         return 1
     namespace = os.environ.get("POD_NAMESPACE") or "kube-system"
+    try:
+        status = ring_status(client, namespace=namespace)
+    except Exception as err:
+        print(f"resize refused: {err}", file=sys.stderr)
+        return 1
+    current = status["shard_count"]
+    if args.shard_count == current:
+        print(
+            f"resize refused: the fleet is already at {current} shards "
+            f"(epoch {status['epoch']}) — nothing to do",
+            file=sys.stderr,
+        )
+        return 1
+    # show the operator exactly what will move before anything acts
+    if current >= 1:
+        plan = transition_plan(HashRing(current), HashRing(args.shard_count))
+        print(
+            f"transition plan {current} -> {args.shard_count} shards: "
+            f"{plan.moved_fraction:.1%} of the keyspace moves"
+        )
+        for donor in sorted(plan.gainers_of):
+            gainers = ", ".join(
+                str(gainer) for gainer in sorted(plan.gainers_of[donor])
+            )
+            print(f"  shard {donor} drains to shard(s) {gainers}")
+    if status["in_flight"] and not args.force:
+        print(
+            "note: a resize transition is still in flight — the request "
+            "will be refused unless --force",
+        )
+    if args.dry_run:
+        print("dry run: ring lease not written")
+        return 0
     try:
         epoch = request_resize(
             client, args.shard_count, namespace=namespace, force=args.force

@@ -531,6 +531,13 @@ class Manager:
             return counts
         return counts
 
+    def keys_by_shard(self) -> dict[int, int]:
+        """The per-shard managed-key census under the live ring, as a
+        documented public accessor — the autoscaler's load-board
+        signal (ISSUE 13) reads this instead of reaching into the
+        placement internals.  Empty when sharding is disabled."""
+        return self._count_keys_by_shard()
+
     def drift_tick(self) -> int:
         """Drive ONE drift-resync round explicitly: walk every
         registered controller's own ``drift_resync_sources()`` — the
@@ -690,6 +697,9 @@ class _HealthHandler(BaseHTTPRequestHandler):
         if self.path == "/debug/queues":
             self._respond(200, self.server.queue_status())
             return
+        if self.path == "/debug/autoscaler":
+            self._autoscaler()
+            return
         self.send_error(404)
 
     def _healthz(self):
@@ -716,6 +726,9 @@ class _HealthHandler(BaseHTTPRequestHandler):
             # state — the block the rollout/federation gates read;
             # the full view (objectives, slowest journeys) is /slo
             "slo": self.server.slo_status(),
+            # shard autoscaler (ISSUE 13): rail/knob settings and the
+            # last decision; full history is /debug/autoscaler
+            "autoscaler": self.server.autoscaler_status(),
         }
         self._respond(500 if stuck else 200, body)
 
@@ -775,6 +788,19 @@ class _HealthHandler(BaseHTTPRequestHandler):
             },
         )
 
+    def _autoscaler(self):
+        """The autoscaler's bounded decision history, oldest → newest,
+        each entry carrying the full evidence snapshot the policy saw
+        — suppressed decisions included (a quiet autoscaler should be
+        explainably quiet)."""
+        self._respond(
+            200,
+            {
+                "status": self.server.autoscaler_status(),
+                "decisions": self.server.autoscaler_history(),
+            },
+        )
+
     def _respond(self, code: int, body: dict):
         payload = json.dumps(body).encode()
         self.send_response(code)
@@ -797,6 +823,8 @@ def make_health_server(
     slo_status: Optional[Callable[[], dict]] = None,
     fleet_view: Optional["obs_fleet.FleetView"] = None,
     queue_status: Optional[Callable[[], dict]] = None,
+    autoscaler_status: Optional[Callable[[], dict]] = None,
+    autoscaler_history: Optional[Callable[[], list]] = None,
 ) -> ThreadingHTTPServer:
     """Build the manager's health endpoint (bind port 0 in tests);
     call ``serve_forever`` on a daemon thread to serve.  ``gc_status``
@@ -817,6 +845,8 @@ def make_health_server(
     server.shard_status = shard_status or (lambda: {"enabled": False})
     server.queue_status = queue_status or (lambda: {})
     server.slo_status = slo_status or obs_slo.status_or_disabled
+    server.autoscaler_status = autoscaler_status or (lambda: {"enabled": False})
+    server.autoscaler_history = autoscaler_history or (lambda: [])
     server.metrics_registry = (
         metrics_registry if metrics_registry is not None else obs_metrics.registry()
     )

@@ -281,6 +281,15 @@ class JourneyTracker:
             return 0.0
         return max(0.0, self._clock() - oldest)
 
+    def oldest_unconverged_age(self, controller: Optional[str] = None) -> float:
+        """Age in seconds of the oldest journey still in flight
+        (0.0 when nothing is in flight) — the documented public
+        accessor the autoscaler's signal collector reads, and the same
+        number ``agac_journey_oldest_unconverged_age_seconds``
+        exports.  ``controller`` narrows to one controller's
+        journeys; the default spans the whole tracker."""
+        return self.oldest_age(controller)
+
     def slowest(self, limit: int = 10) -> list[dict]:
         """The oldest unconverged journeys, oldest first — the
         ``/slo`` endpoint's drill-down list (each entry's id is

@@ -314,6 +314,17 @@ class SLOEngine:
         self._metrics.sheds.labels(action=action).inc()
         return True
 
+    def burn_snapshot(self) -> dict[str, dict[float, float]]:
+        """The last ``tick()``'s burn map, copied under the lock:
+        ``{objective name: {window seconds: burn rate}}``.  This is
+        the stable in-process read the autoscaler's signal collector
+        consumes — identical numbers to the ``/slo`` endpoint's
+        ``burn`` blocks, but keyed by the raw float window (no string
+        formatting) and safe to call from any thread.  Empty until
+        the first tick."""
+        with self._lock:
+            return {name: dict(per) for name, per in self._burn.items()}
+
     def violations(self) -> list[str]:
         """Objectives whose CUMULATIVE good fraction misses the target
         — the sim/fuzz oracle's verdict (a whole-run property, not a

@@ -36,6 +36,7 @@ from .membership import (
     request_resize,
     resize_in_flight,
     ring_lease_name,
+    ring_status,
 )
 from .ring import HashRing, RingTransition, transition_plan
 
@@ -52,5 +53,6 @@ __all__ = [
     "request_resize",
     "resize_in_flight",
     "ring_lease_name",
+    "ring_status",
     "transition_plan",
 ]

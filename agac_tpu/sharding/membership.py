@@ -330,6 +330,36 @@ def resize_in_flight(anns: dict, vnodes: int = DEFAULT_VNODES) -> bool:
     return not plan.gainers <= adopted
 
 
+def ring_status(
+    client,
+    namespace: str = "kube-system",
+    lease_prefix: str = "agac-shard",
+    vnodes: int = DEFAULT_VNODES,
+) -> dict:
+    """Read-only view of the ring lease for CLI/tooling: the live
+    target shard count, the origin of any transition, the resize
+    epoch, and whether a transition is still in flight.  Raises
+    RuntimeError when the lease is absent (no sharded fleet)."""
+    name = ring_lease_name(lease_prefix)
+    try:
+        lease = client.get("Lease", namespace, name)
+    except NotFoundError:
+        raise RuntimeError(
+            f"ring lease {namespace}/{name} not found — is a sharded "
+            "fleet (--shard-count >= 2) running?"
+        )
+    anns = dict(lease.metadata.annotations or {})
+    target = int(anns.get(ANN_TARGET, 0) or 0)
+    origin = int(anns.get(ANN_FROM, target) or target)
+    epoch = int(anns.get(ANN_EPOCH, 0) or 0)
+    return {
+        "shard_count": target,
+        "from_shards": origin,
+        "epoch": epoch,
+        "in_flight": resize_in_flight(anns, vnodes),
+    }
+
+
 def request_resize(
     client,
     target_count: int,

@@ -46,6 +46,12 @@ from typing import Optional
 
 from .. import apis, klog
 from ..analysis import racecheck
+from ..autoscaler import (
+    ACTION_IN,
+    ACTION_OUT,
+    RAIL_OBSERVE_ONLY,
+    ScalePolicyConfig,
+)
 from ..cloudprovider.aws.health import GA_OPS, ROUTE53_OPS, HealthConfig
 from .harness import SimHarness, SimHarnessConfig
 from .oracles import (
@@ -450,6 +456,422 @@ def run_resize_scenario(
         racecheck.disable()
 
 
+# --- the SLO-driven autoscaler scenarios (ISSUE 13) -----------------------
+#
+# Sim-scale burn windows: the production 5 m / 1 h windows would let a
+# load wave poison scale-in headroom for an hour of virtual time after
+# it ended; 2 m / 10 m keeps trip AND decay inside one mini run.
+AUTOSCALE_WINDOWS = (120.0, 600.0)
+# the load wave: a burst of GA-managed services big enough to saturate
+# per-replica workqueue admission (the scarce resource queue_qps
+# models), and a post-reaction "echo" burst that must converge fast on
+# the scaled-out fleet
+_WAVE_SIZE = 28
+_ECHO_SIZE = 8
+_WAVE_AT = 180.0
+
+
+def _autoscale_objectives():
+    from ..observability.slo import GA_CONTROLLERS, SLOObjective
+
+    # one fast-tripping objective: GA chains within 60 s (a
+    # JOURNEY_BUCKETS bound) at a target relaxed enough that the
+    # cumulative good fraction can recover above it after the
+    # autoscaler reacts — keeping check_slo meaningful post-scale
+    return (
+        SLOObjective("ga_converge_wave_p99", 60.0, GA_CONTROLLERS, target=0.7),
+    )
+
+
+def _autoscale_policy(
+    observe_only: bool = False, brownout_hold: float = 600.0
+) -> ScalePolicyConfig:
+    # production-shaped rails scaled to the mini profile's 900 s active
+    # window; cooldowns still outlast the membership plane's ~30 s
+    # placement hysteresis by 3x/15x
+    return ScalePolicyConfig(
+        min_shards=2,
+        max_shards=4,
+        burn_threshold=1.0,
+        age_growth_evals=3,
+        age_floor_seconds=90.0,
+        headroom_evals=4,
+        headroom_burn=0.25,
+        cooldown_out_seconds=90.0,
+        cooldown_in_seconds=450.0,
+        brownout_hold_seconds=brownout_hold,
+        observe_only=observe_only,
+    )
+
+
+def _autoscale_config(
+    observe_only: bool = False, brownout_hold: float = 600.0
+) -> SimHarnessConfig:
+    return SimHarnessConfig(
+        replicas=4,
+        shard_count=2,
+        shards_per_replica=2,
+        resync_period=1800.0,
+        gc_sweep_period=450.0,
+        gc_grace_sweeps=2,
+        # per-replica workqueue admission is the scarce resource the
+        # wave saturates: more shards spread across more replicas =
+        # more aggregate admission capacity, which is exactly the
+        # lever the autoscaler pulls.  0.1 qps/burst 2 means a
+        # 14-key-per-owner wave queues for ~2 minutes at 2 shards but
+        # ~1 minute at 4 — the difference the objective sees
+        queue_qps=0.1,
+        queue_burst=2,
+        health=HealthConfig(
+            window=30.0,
+            min_calls=6,
+            failure_ratio=0.5,
+            open_duration=15.0,
+            probe_budget=1,
+            aimd_qps=50.0,
+        ),
+        lease=_fast_lease(),
+        slo_objectives=_autoscale_objectives(),
+        slo_windows=AUTOSCALE_WINDOWS,
+        autoscale=True,
+        autoscale_interval=30.0,
+        autoscale_policy=_autoscale_policy(
+            observe_only=observe_only, brownout_hold=brownout_hold
+        ),
+    )
+
+
+def _autoscale_stats(harness: SimHarness) -> dict:
+    decisions = harness.autoscaler.history()
+    return {
+        "status": harness.autoscaler.status(),
+        "decisions": len(decisions),
+        "executed": [
+            (d["time"], d["action"], d["target_shards"])
+            for d in decisions
+            if d["executed"]
+        ],
+        "suppressed_recommendations": sum(
+            1 for d in decisions if RAIL_OBSERVE_ONLY in d["rails"]
+        ),
+        "resize": dict(harness.resize_states()),
+    }
+
+
+def run_autoscale_scenario(
+    seed: int,
+    profile: str = "mini",
+    no_faults: bool = False,
+    observe_only: bool = False,
+) -> ScenarioResult:
+    """The closed-loop canary (ISSUE 13): a sharded fleet (2 shards, 4
+    replicas) under background churn takes a load wave that saturates
+    per-replica queue admission and blows the convergence objective.
+    The autoscaler — not the scenario script — must notice the burn /
+    age growth and execute the 2→4 resize within its evaluation
+    budget; a replica kill is composed into the wave window (the CI
+    canary: load wave × replica kill).  After the wave the fleet must
+    scale back in on sustained headroom, with zero oscillation and
+    every decision flight-recorded.  ``observe_only`` flips the policy
+    to recommendation-only and instead asserts the wave produced a
+    suppressed recommendation and NO resize was ever requested."""
+    shape = PROFILES[profile]
+    rng = random.Random(seed)
+    config = _autoscale_config(observe_only=observe_only)
+    watchdog = racecheck.enable()
+    try:
+        with SimHarness(config=config) as harness:
+            for slot in range(shape.service_slots):
+                harness.aws.add_load_balancer(
+                    f"lb{slot}", "us-west-2", _nlb_hostname(slot)
+                )
+            wave_slots = list(range(100, 100 + _WAVE_SIZE))
+            echo_slots = list(range(200, 200 + _ECHO_SIZE))
+            for slot in wave_slots + echo_slots:
+                harness.aws.add_load_balancer(
+                    f"lb{slot}", "us-west-2", _nlb_hostname(slot)
+                )
+            harness.aws.add_hosted_zone("example.com")
+            harness.run_for(15.0)  # membership + initial sync
+            harness.spawn(_churn_actor(harness, rng, shape), "churn")
+
+            def wave_actor():
+                # a tight burst: arrival rate far above the per-owner
+                # admission rate, so the backlog actually queues
+                for slot in wave_slots:
+                    harness.cluster.create(
+                        "Service", _make_service(f"wavesvc{slot}", slot, False)
+                    )
+                    yield rng.uniform(0.5, 1.5)
+
+            def echo_actor():
+                for slot in echo_slots:
+                    harness.cluster.create(
+                        "Service", _make_service(f"echosvc{slot}", slot, False)
+                    )
+                    yield rng.uniform(2.0, 6.0)
+
+            harness.after(
+                _WAVE_AT,
+                lambda: harness.spawn(wave_actor(), "load-wave"),
+                "load-wave-start",
+            )
+            # the echo burst lands after the reaction budget: it must
+            # converge fast on the scaled-out fleet, which is what
+            # pulls the cumulative good fraction back over target
+            harness.after(
+                _WAVE_AT + 480.0,
+                lambda: harness.spawn(echo_actor(), "echo-wave"),
+                "echo-wave-start",
+            )
+            # observe-only runs the SAME wave + fault as the acting run:
+            # the whole point is proving the identical evidence produces
+            # a suppressed recommendation instead of a resize
+            if not no_faults:
+                harness.after(
+                    _WAVE_AT + 60.0,
+                    lambda: harness.kill_shard_replica(replace=True),
+                    "kill-replica-in-wave",
+                )
+            harness.run_for(shape.active_seconds)
+            harness.fault_plan.restore()
+            harness.fault_plan.refill(0)
+            quiesced = harness.run_until_quiescent(
+                shape.heal_seconds, settle_window=3 * 60.0
+            )
+            # the quiet the scale-in evidence needs: wave burn must
+            # age out of the long window, then the headroom streak and
+            # scale-in cooldown must elapse, then the 4→2 transition
+            # itself must drain/hand off
+            harness.run_for(900.0)
+            quiesced = quiesced and harness.run_until_quiescent(
+                shape.heal_seconds, settle_window=3 * 60.0
+            )
+            harness.run_for(3 * 450.0)  # GC grace for killed-replica orphans
+            quiesced = quiesced and harness.run_until_quiescent(
+                shape.heal_seconds, settle_window=3 * 60.0
+            )
+
+            violations = list(harness.violations)
+            if not quiesced:
+                violations.append(
+                    "quiescence: world still busy after "
+                    f"{shape.heal_seconds}s virtual heal window"
+                )
+            violations += standard_oracles(harness, config.cluster_name)
+            decisions = harness.autoscaler.history()
+            executed = [d for d in decisions if d["executed"]]
+            outs = [d for d in executed if d["action"] == ACTION_OUT]
+            ins = [d for d in executed if d["action"] == ACTION_IN]
+            status = harness.autoscaler.status()
+            recorded = harness.autoscaler_recorder.recorded_total
+            if recorded != status["evaluations"]:
+                violations.append(
+                    f"autoscale: {status['evaluations']} evaluations but "
+                    f"{recorded} flight-recorded decisions"
+                )
+            if observe_only:
+                recommended = [
+                    d for d in decisions if RAIL_OBSERVE_ONLY in d["rails"]
+                ]
+                if not recommended:
+                    violations.append(
+                        "autoscale: observe-only run never produced a "
+                        "suppressed recommendation (proof is vacuous)"
+                    )
+                if executed or harness._resize_requests:
+                    violations.append(
+                        "autoscale: observe-only run requested a resize: "
+                        f"{harness._resize_requests}"
+                    )
+                if not harness.resize_settled(2):
+                    violations.append(
+                        "autoscale: observe-only fleet left 2 shards: "
+                        f"{harness.resize_states()}"
+                    )
+            else:
+                if not outs:
+                    violations.append(
+                        "autoscale: load wave never produced an executed "
+                        "scale-out"
+                    )
+                else:
+                    reaction = outs[0]["time"] - _WAVE_AT
+                    if reaction > 450.0:
+                        violations.append(
+                            "autoscale: scale-out reacted too slowly "
+                            f"({reaction:.0f}s after the wave started)"
+                        )
+                    if outs[0]["target_shards"] != 4:
+                        violations.append(
+                            "autoscale: first scale-out targeted "
+                            f"{outs[0]['target_shards']} shards, expected 4"
+                        )
+                if outs and not ins:
+                    violations.append(
+                        "autoscale: fleet never scaled back in after the wave"
+                    )
+                if not harness.resize_settled(2):
+                    violations.append(
+                        "autoscale: fleet did not return to 2 shards: "
+                        f"{harness.resize_states()}"
+                    )
+                # post-reaction SLO verdict: cumulative good fraction
+                # must have recovered over target — the whole point of
+                # the reaction
+                harness.slo_engine.tick()
+                violations += check_slo(harness)
+            try:
+                watchdog.assert_clean()
+            except AssertionError as err:
+                violations.append(f"racecheck: {err}")
+            stats = harness.stats()
+            stats["autoscale"] = _autoscale_stats(harness)
+            stats["slo"] = {
+                "violations": check_slo(harness),
+                "journeys": harness.journey.stats(),
+            }
+            return ScenarioResult(
+                seed=seed,
+                profile=profile,
+                canary="autoscale-observe" if observe_only else "autoscale",
+                trace_hash=harness.trace_hash(),
+                violations=violations,
+                stats=stats,
+                trace_tail=list(harness.scheduler.trace_tail)[-200:],
+            )
+    finally:
+        racecheck.disable()
+
+
+def run_autoscale_brownout_scenario(
+    seed: int, profile: str = "mini"
+) -> ScenarioResult:
+    """The brownout discrimination proof (ISSUE 13): a sustained GA
+    outage wedges every journey opened during it; they converge — and
+    burn the error budget — only AFTER the restore, when the circuit
+    is already closed again.  The autoscaler must attribute that burn
+    to the provider (open-circuit exclusion + the brownout hold) and
+    execute ZERO scale-outs, and the proof must be non-vacuous: the
+    decision history has to actually show both-window burn AND
+    excluded objectives."""
+    shape = PROFILES[profile]
+    rng = random.Random(seed)
+    config = _autoscale_config(brownout_hold=900.0)
+    watchdog = racecheck.enable()
+    try:
+        with SimHarness(config=config) as harness:
+            for slot in range(shape.service_slots):
+                harness.aws.add_load_balancer(
+                    f"lb{slot}", "us-west-2", _nlb_hostname(slot)
+                )
+            wedge_slots = list(range(100, 108))
+            for slot in wedge_slots:
+                harness.aws.add_load_balancer(
+                    f"lb{slot}", "us-west-2", _nlb_hostname(slot)
+                )
+            harness.aws.add_hosted_zone("example.com")
+            harness.run_for(15.0)
+            harness.spawn(_churn_actor(harness, rng, shape), "churn")
+            ops = sorted(GA_OPS)
+            harness.after(
+                120.0, lambda: harness.fault_plan.outage(*ops), "brownout"
+            )
+
+            def wedge_actor():
+                # a burst INTO the outage: failing creates trip the
+                # owner replicas' breakers and wedge enough journeys
+                # that the post-restore burn is unmistakable
+                for slot in wedge_slots:
+                    harness.cluster.create(
+                        "Service", _make_service(f"wavesvc{slot}", slot, False)
+                    )
+                    yield rng.uniform(1.0, 4.0)
+
+            harness.after(
+                130.0,
+                lambda: harness.spawn(wedge_actor(), "wedge-wave"),
+                "wedge-wave-start",
+            )
+            harness.after(
+                420.0,
+                lambda: harness.fault_plan.restore(*ops),
+                "brownout-end",
+            )
+            harness.run_for(shape.active_seconds)
+            harness.fault_plan.restore()
+            harness.fault_plan.refill(0)
+            quiesced = harness.run_until_quiescent(
+                shape.heal_seconds, settle_window=3 * 60.0
+            )
+            violations = list(harness.violations)
+            if not quiesced:
+                violations.append(
+                    "quiescence: world still busy after "
+                    f"{shape.heal_seconds}s virtual heal window"
+                )
+            violations += standard_oracles(harness, config.cluster_name)
+            decisions = harness.autoscaler.history()
+            executed_out = [
+                d
+                for d in decisions
+                if d["executed"] and d["action"] == ACTION_OUT
+            ]
+            if executed_out:
+                violations.append(
+                    "autoscale: scaled out on provider-outage burn at "
+                    f"t={executed_out[0]['time']}"
+                )
+            if not any(
+                d["evidence"].get("excluded_objectives") for d in decisions
+            ):
+                violations.append(
+                    "autoscale: brownout never excluded an objective — "
+                    "the no-scale-out assertion is vacuous"
+                )
+
+            def burning(decision):
+                for per in decision["evidence"]["burn"].values():
+                    if per and all(rate >= 1.0 for rate in per.values()):
+                        return True
+                return False
+
+            if not any(burning(d) for d in decisions):
+                violations.append(
+                    "autoscale: outage never produced both-window burn — "
+                    "the no-scale-out assertion is vacuous"
+                )
+            if not harness.resize_settled(2):
+                violations.append(
+                    "autoscale: brownout fleet left 2 shards: "
+                    f"{harness.resize_states()}"
+                )
+            status = harness.autoscaler.status()
+            recorded = harness.autoscaler_recorder.recorded_total
+            if recorded != status["evaluations"]:
+                violations.append(
+                    f"autoscale: {status['evaluations']} evaluations but "
+                    f"{recorded} flight-recorded decisions"
+                )
+            try:
+                watchdog.assert_clean()
+            except AssertionError as err:
+                violations.append(f"racecheck: {err}")
+            stats = harness.stats()
+            stats["autoscale"] = _autoscale_stats(harness)
+            return ScenarioResult(
+                seed=seed,
+                profile=profile,
+                canary="autoscale-brownout",
+                trace_hash=harness.trace_hash(),
+                violations=violations,
+                stats=stats,
+                trace_tail=list(harness.scheduler.trace_tail)[-200:],
+            )
+    finally:
+        racecheck.disable()
+
+
 def _fast_lease():
     from ..leaderelection import LeaderElectionConfig
 
@@ -637,11 +1059,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--profile", default="quick", choices=sorted(PROFILES))
     parser.add_argument("--canary", default=None, choices=CANARIES)
     parser.add_argument(
-        "--scenario", default="standard", choices=("standard", "resize"),
+        "--scenario", default="standard",
+        choices=("standard", "resize", "autoscale", "autoscale-brownout"),
         help="'resize' plays the sharded resize-under-faults scenario "
         "(live 2→4 resize composed with replica death + brownout, "
         "key-level ownership and handoff oracles armed) instead of the "
-        "single-leader churn scenario",
+        "single-leader churn scenario; 'autoscale' plays the "
+        "closed-loop canary (load wave × replica kill — the autoscaler "
+        "itself must execute the 2→4→2 resizes, no-oscillation + "
+        "ownership oracles armed); 'autoscale-brownout' proves burn "
+        "caused by a provider outage never scales the fleet out",
     )
     parser.add_argument(
         "--no-faults", action="store_true",
@@ -657,6 +1084,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         if args.scenario == "resize":
             result = run_resize_scenario(
                 seed, profile=args.profile, no_faults=args.no_faults
+            )
+        elif args.scenario == "autoscale":
+            result = run_autoscale_scenario(
+                seed, profile=args.profile, no_faults=args.no_faults
+            )
+        elif args.scenario == "autoscale-brownout":
+            result = run_autoscale_brownout_scenario(
+                seed, profile=args.profile
             )
         else:
             result = run_scenario(

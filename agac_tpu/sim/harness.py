@@ -42,6 +42,12 @@ from dataclasses import dataclass, field
 from typing import Callable, Generator, Optional
 
 from .. import klog
+from ..autoscaler import (
+    AutoscalerLoop,
+    ScalePolicy,
+    ScalePolicyConfig,
+    ScaleSignals,
+)
 from ..cloudprovider.aws import AWSDriver
 from ..cloudprovider.aws.batcher import ChangeBatcher
 from ..cloudprovider.aws.cache import (
@@ -76,6 +82,7 @@ from ..manager import ControllerConfig, Manager
 from ..observability import fleet as obs_fleet
 from ..observability import journey as obs_journey
 from ..observability import metrics as obs_metrics
+from ..observability import recorder as obs_recorder
 from ..observability import slo as obs_slo
 from ..reconcile.pending import PendingSettleTable
 from ..reconcile.reconcile import process_next_work_item
@@ -146,6 +153,28 @@ class SimHarnessConfig:
     # sustained burn actually defer GC sweeps / drift ticks
     slo_eval_interval: float = 15.0
     slo_shed_gates: bool = False
+    # objective override for the per-scenario SLO engine (None = the
+    # shipped default_objectives()).  Autoscale scenarios declare
+    # fast-tripping low-threshold objectives so a load wave burns the
+    # budget within sim-scale minutes AND the cumulative good fraction
+    # can recover above target after the reaction — keeping check_slo
+    # meaningful post-scale instead of permanently poisoned by the wave
+    slo_objectives: Optional[tuple] = None
+    # burn-window override (None = DEFAULT_WINDOWS, 5 m / 1 h).  The
+    # autoscale scenarios shrink these so a load wave's burn both
+    # trips AND decays inside one sim-scale run — with the production
+    # 1 h window the wave would poison scale-in headroom for an hour
+    # of virtual time after it ended
+    slo_windows: Optional[tuple] = None
+    # SLO-driven shard autoscaler (ISSUE 13): arms a harness-level
+    # AutoscalerLoop over the scenario's SLO engine, journey tracker,
+    # membership state and health planes, executing through the traced
+    # request_resize verb.  Sharded mode only.  autoscale_policy is a
+    # ScalePolicyConfig (None = defaults — production-shaped cooldowns,
+    # usually too slow for sim scenarios)
+    autoscale: bool = False
+    autoscale_interval: float = 15.0
+    autoscale_policy: Optional[ScalePolicyConfig] = None
     # elastic resharding (ISSUE 10): the longest a moving key may sit
     # unowned between its donor's drain and its gainer's adoption
     # before the handoff oracle flags it; 0 = 4 lease retry periods
@@ -520,6 +549,10 @@ class SimHarness:
         self.handoff_violations: list[str] = []
         self._unowned_since: dict[str, float] = {}
         self._resize_requests: list[int] = []
+        # SLO-driven autoscaler (ISSUE 13): built in __enter__ when
+        # config.autoscale arms it (sharded mode only)
+        self.autoscaler: Optional[AutoscalerLoop] = None
+        self.autoscaler_recorder: Optional[obs_recorder.FlightRecorder] = None
         # hooks the fuzzer uses: called around every GC sweep so
         # continuous oracles can snapshot ownership immediately before
         # the sweep and attribute each deletion to it precisely
@@ -578,6 +611,12 @@ class SimHarness:
         self._prev_journey = obs_journey.install(self.journey)
         self.slo_engine = obs_slo.SLOEngine(
             registry=self.journey_registry,
+            objectives=config.slo_objectives,
+            windows=(
+                config.slo_windows
+                if config.slo_windows is not None
+                else obs_slo.DEFAULT_WINDOWS
+            ),
             clock=self.scheduler.monotonic,
             journey_tracker=self.journey,
             shed_gates=config.slo_shed_gates,
@@ -588,6 +627,12 @@ class SimHarness:
                 config.slo_eval_interval, self.slo_engine.tick, "slo-eval",
                 priority=1,
             )
+        if config.autoscale:
+            # the shard autoscaler (ISSUE 13), tick-driven on the
+            # virtual clock; registered AFTER slo-eval so a co-timed
+            # evaluation sees this instant's burn, not last round's
+            assert self._sharded, "autoscale needs shard_count > 1"
+            self._wire_autoscaler()
 
         if self._sharded:
             # every replica gets its OWN process-world when it is
@@ -628,6 +673,65 @@ class SimHarness:
             for _ in range(config.replicas):
                 self._add_replica()
         return self
+
+    def _wire_autoscaler(self) -> None:
+        """Build the harness-level AutoscalerLoop: signals from the
+        scenario's SLO engine / journey tracker, the first live
+        replica's membership + key census (any replica works — the
+        ring lease is shared truth), the union of live replicas' open
+        circuits, and execution through the traced ``request_resize``
+        verb.  Decisions land in a dedicated flight recorder so
+        scenarios can assert EVERY decision was recorded."""
+        config = self.config
+        self.autoscaler_recorder = obs_recorder.FlightRecorder(
+            capacity=4096, clock=self.scheduler.monotonic
+        )
+
+        def resize_status() -> dict:
+            live = self.live_replicas()
+            if not live:
+                return {}
+            return live[0].stack.manager.shard_membership.resize_status()
+
+        def keys_by_shard() -> dict:
+            live = self.live_replicas()
+            if not live:
+                return {}
+            return live[0].stack.manager.keys_by_shard()
+
+        def open_circuits() -> set:
+            services: set = set()
+            for replica in self.live_replicas():
+                health = replica.world.health
+                if health is not None:
+                    services.update(health.open_services())
+            return services
+
+        signals = ScaleSignals(
+            slo_engine=self.slo_engine,
+            journey_tracker=self.journey,
+            resize_status=resize_status,
+            keys_by_shard=keys_by_shard,
+            replica_count=lambda: len(self.live_replicas()),
+            open_circuits=open_circuits,
+            clock=self.scheduler.monotonic,
+        )
+        policy = ScalePolicy(config.autoscale_policy or ScalePolicyConfig())
+        self.autoscaler = AutoscalerLoop(
+            signals,
+            policy,
+            execute=self.request_resize,
+            registry=self.journey_registry,
+            flight_recorder=self.autoscaler_recorder,
+        )
+
+        def autoscale_tick() -> None:
+            if self.live_replicas():
+                self.autoscaler.tick()
+
+        self.scheduler.every(
+            config.autoscale_interval, autoscale_tick, "autoscale", priority=1
+        )
 
     def _make_controller_config(
         self, sharding: Optional[ShardingConfig] = None

@@ -246,6 +246,35 @@ def check_slo(harness) -> list[str]:
     return engine.violations()
 
 
+def check_autoscaler_oscillation(
+    harness, max_flips: int = 2, window: float = 3600.0
+) -> list[str]:
+    """The no-oscillation oracle (ISSUE 13): EXECUTED scale decisions
+    must not flip direction more than ``max_flips`` times within any
+    sliding ``window`` of virtual seconds — a flapping autoscaler
+    churns the keyspace through drain/handoff transitions for nothing
+    and is strictly worse than no autoscaler.  A harness without an
+    autoscaler is vacuously clean."""
+    loop = getattr(harness, "autoscaler", None)
+    if loop is None:
+        return []
+    executed = [d for d in loop.history() if d["executed"]]
+    flips = [
+        current["time"]
+        for previous, current in zip(executed, executed[1:])
+        if current["action"] != previous["action"]
+    ]
+    for i, start in enumerate(flips):
+        in_window = [t for t in flips[i:] if t - start <= window]
+        if len(in_window) > max_flips:
+            return [
+                f"autoscaler-oscillation: {len(in_window)} direction flips "
+                f"within {window:g}s starting t={start:.0f} "
+                f"(allowed {max_flips})"
+            ]
+    return []
+
+
 def standard_oracles(harness, cluster_name: str = "default") -> list[str]:
     """The full final-state battery."""
     violations = (
@@ -257,6 +286,8 @@ def standard_oracles(harness, cluster_name: str = "default") -> list[str]:
     if getattr(harness, "_sharded", False):
         violations += check_exclusive_shard_ownership(harness)
         violations += check_resize_handoffs(harness)
+    if getattr(harness, "autoscaler", None) is not None:
+        violations += check_autoscaler_oscillation(harness)
     return violations
 
 

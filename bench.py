@@ -1663,6 +1663,136 @@ def run_sharding_phase() -> dict:
     return phase
 
 
+# the autoscaler reaction benchmark (ISSUE 13): one load-wave run of
+# the closed-loop sim scenario, plus its observe-only twin.  The sim
+# harness installs the process-global virtual clock, so both runs are
+# subprocess-isolated from this real-clock bench — same reason the
+# sharding phase forks.
+AUTOSCALE_SEED = int(os.environ.get("AGAC_BENCH_AUTOSCALE_SEED", "1"))
+AUTOSCALE_PROFILE = os.environ.get("AGAC_BENCH_AUTOSCALE_PROFILE", "mini")
+
+_AUTOSCALE_CHILD = r"""
+import json
+import sys
+
+from agac_tpu.autoscaler import ACTION_IN, ACTION_OUT
+from agac_tpu.sim import fuzz
+
+observe_only = sys.argv[1] == "observe"
+result = fuzz.run_autoscale_scenario(
+    int(sys.argv[2]), profile=sys.argv[3], observe_only=observe_only
+)
+auto = result.stats["autoscale"]
+outs = [t for t, action, _ in auto["executed"] if action == ACTION_OUT]
+ins = [t for t, action, _ in auto["executed"] if action == ACTION_IN]
+print(json.dumps({
+    "violations": result.violations,
+    "trace_hash": result.trace_hash,
+    "wave_at_s": fuzz._WAVE_AT,
+    "decisions": auto["decisions"],
+    "suppressed_recommendations": auto["suppressed_recommendations"],
+    "executed": auto["executed"],
+    "first_scale_out_at_s": outs[0] if outs else None,
+    "first_scale_in_at_s": ins[0] if ins else None,
+    "virtual_s": result.stats["virtual_time"],
+    "aws_calls": result.stats["aws_calls"],
+}))
+"""
+
+
+def _run_autoscale_child(observe_only: bool) -> dict:
+    import subprocess
+
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            _AUTOSCALE_CHILD,
+            "observe" if observe_only else "act",
+            str(AUTOSCALE_SEED),
+            AUTOSCALE_PROFILE,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        raise SystemExit(
+            f"autoscaler phase: scenario subprocess failed:\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    return json.loads(lines[-1])
+
+
+def run_autoscaler_phase() -> dict:
+    """The SLO-driven autoscaler's reaction time (ISSUE 13): the load
+    wave blows the convergence objective at a known virtual instant;
+    the closed loop (burn/age signals -> policy -> live 2->4 resize)
+    must notice and act.  Reported as virtual seconds from the wave
+    start: ``spike_to_scale_out_s`` is the executed scale-out,
+    ``spike_to_scale_in_s`` is the scale-back — which by construction
+    marks sustained sub-threshold burn on every SLO window (the p99
+    restored, the headroom streak and cooldown served).  The
+    observe-only twin runs the identical wave + fault and must record
+    suppressed recommendations while never requesting a resize."""
+    acting = _run_autoscale_child(observe_only=False)
+    if acting["violations"]:
+        raise SystemExit(
+            f"autoscaler phase: load-wave scenario violated its oracles: "
+            f"{acting['violations']}"
+        )
+    if acting["first_scale_out_at_s"] is None:
+        raise SystemExit("autoscaler phase: wave produced no executed scale-out")
+    reaction = round(acting["first_scale_out_at_s"] - acting["wave_at_s"], 1)
+    restored = round(acting["first_scale_in_at_s"] - acting["wave_at_s"], 1)
+    _progress(
+        f"autoscaler: scale-out {reaction}s after the wave, "
+        f"scaled back in at +{restored}s (virtual; seed {AUTOSCALE_SEED})"
+    )
+    observe = _run_autoscale_child(observe_only=True)
+    if observe["violations"]:
+        raise SystemExit(
+            f"autoscaler phase: observe-only scenario violated its oracles: "
+            f"{observe['violations']}"
+        )
+    if observe["executed"]:
+        raise SystemExit(
+            f"autoscaler phase: observe-only run executed a resize: "
+            f"{observe['executed']}"
+        )
+    _progress(
+        f"autoscaler: observe-only twin suppressed "
+        f"{observe['suppressed_recommendations']} recommendations, 0 resizes"
+    )
+    return {
+        "seed": AUTOSCALE_SEED,
+        "profile": AUTOSCALE_PROFILE,
+        "wave_at_s": acting["wave_at_s"],
+        "spike_to_scale_out_s": reaction,
+        "spike_to_scale_in_s": restored,
+        "decisions": acting["decisions"],
+        "executed": acting["executed"],
+        "virtual_s": acting["virtual_s"],
+        "aws_calls": acting["aws_calls"],
+        "trace_hash": acting["trace_hash"],
+        "observe_only": {
+            "decisions": observe["decisions"],
+            "suppressed_recommendations": observe["suppressed_recommendations"],
+            "executed": observe["executed"],
+            "trace_hash": observe["trace_hash"],
+        },
+        "note": (
+            "virtual seconds on the sim scheduler, wave starts at wave_at_s; "
+            "scale-in certifies sustained sub-threshold burn on every SLO "
+            "window (p99 restored) plus the headroom streak and cooldown; "
+            "the scenario's own oracles (reaction budget, SLO verdict, "
+            "no-oscillation, flight-record completeness) all passed"
+        ),
+    }
+
+
 def main():
     klog.init(verbosity=-1)
     import logging
@@ -1747,6 +1877,13 @@ def main():
             for width, block in sharding["sweep"].items()
         )
     )
+    # the autoscaler reaction benchmark (ISSUE 13): subprocess-isolated
+    # sim runs, so the virtual clockseam never touches this process
+    _progress(
+        f"autoscaler: load-wave reaction scenario (seed {AUTOSCALE_SEED}, "
+        f"profile {AUTOSCALE_PROFILE}) + observe-only twin"
+    )
+    autoscaler = run_autoscaler_phase()
 
     steady = tuned.pop("steady_state")
     churn = tuned.pop("egb_churn")
@@ -1772,6 +1909,10 @@ def main():
         # headline vs two concurrently-live replicas, with quota
         # division asserted
         "sharding": sharding,
+        # the SLO-driven autoscaler's measured reaction (ISSUE 13):
+        # spike -> executed scale-out -> scale-back-in (= p99 restored
+        # + headroom sustained), plus the observe-only twin's proof
+        "autoscaler": autoscaler,
         "latency_model": {
             "scale": f"real-world seconds / {LATENCY_SCALE:g}; quotas x{LATENCY_SCALE:g}",
             "real_latency_s": REAL_LATENCY,
@@ -1827,6 +1968,15 @@ def main():
                 for width, block in sharding["sweep"].items()
             },
             "efficiency_4": sharding["sweep"].get("4", {}).get("efficiency"),
+        },
+        # the autoscaler's reaction at a glance (ISSUE 13): virtual
+        # seconds from the load-wave spike to the executed scale-out
+        # and to the scale-back (p99 restored), and the observe-only
+        # twin's resize count (must be 0)
+        "autoscaler": {
+            "react_s": autoscaler["spike_to_scale_out_s"],
+            "restore_s": autoscaler["spike_to_scale_in_s"],
+            "observe_resizes": len(autoscaler["observe_only"]["executed"]),
         },
         # fleet-merged convergence SLO signals (ISSUE 9): per-kind
         # journey p99 of the tuned phase (through the fleet-merge

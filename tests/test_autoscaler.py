@@ -1,0 +1,708 @@
+"""Unit tier for the SLO-driven shard autoscaler (ISSUE 13):
+``agac_tpu/autoscaler/`` — signal collection (``signals.py``), the
+railed scale policy as a pure fake-clock state machine (``policy.py``),
+and the collect→evaluate→record→act loop (``loop.py``).  Every rail
+gets a direct test here; the closed-loop behavior (load wave → resize →
+restored SLO) is proven by the sim tier (``sim/fuzz.py --scenario
+autoscale``) and tests/test_sharding_sim.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from agac_tpu.autoscaler import (
+    ACTION_HOLD,
+    ACTION_IN,
+    ACTION_OUT,
+    RAIL_AT_MAX,
+    RAIL_AT_MIN,
+    RAIL_COOLDOWN_IN,
+    RAIL_COOLDOWN_OUT,
+    RAIL_DISABLED,
+    RAIL_EXECUTE_ERROR,
+    RAIL_OBSERVE_ONLY,
+    RAIL_TRANSITION,
+    REASON_AGE,
+    REASON_BURN,
+    REASON_HEADROOM,
+    REASON_STEADY,
+    AutoscalerLoop,
+    ScalePolicy,
+    ScalePolicyConfig,
+    ScaleSignals,
+    SignalSnapshot,
+    services_for_controllers,
+)
+from agac_tpu.observability.metrics import MetricsRegistry, parse_text
+from agac_tpu.observability.recorder import FlightRecorder
+
+GA_OBJ = "ga_converge_p99"
+R53_OBJ = "route53_converge_p99"
+
+
+class FakeClock:
+    def __init__(self, now: float = 0.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def snap(
+    time=0.0,
+    shard_count=2,
+    resize_state="stable",
+    handoff_pending=0,
+    burn=None,
+    objective_services=None,
+    oldest_age=0.0,
+    open_circuits=(),
+    **kw,
+):
+    return SignalSnapshot(
+        time=time,
+        shard_count=shard_count,
+        resize_state=resize_state,
+        handoff_pending=handoff_pending,
+        burn=burn if burn is not None else {},
+        objective_services=(
+            objective_services
+            if objective_services is not None
+            else {GA_OBJ: frozenset(["globalaccelerator"])}
+        ),
+        oldest_age=oldest_age,
+        open_circuits=frozenset(open_circuits),
+        **kw,
+    )
+
+
+def burning(rate=2.0):
+    """Both-window burn at ``rate`` on the GA objective."""
+    return {GA_OBJ: {300.0: rate, 3600.0: rate}}
+
+
+def cool():
+    return {GA_OBJ: {300.0: 0.0, 3600.0: 0.0}}
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+class TestScalePolicyConfig:
+    def test_defaults_are_valid(self):
+        cfg = ScalePolicyConfig()
+        assert cfg.min_shards == 1 and cfg.max_shards == 8
+
+    def test_min_shards_must_be_positive(self):
+        with pytest.raises(ValueError):
+            ScalePolicyConfig(min_shards=0)
+
+    def test_max_must_not_be_below_min(self):
+        with pytest.raises(ValueError):
+            ScalePolicyConfig(min_shards=4, max_shards=2)
+
+    def test_streaks_must_be_positive(self):
+        with pytest.raises(ValueError):
+            ScalePolicyConfig(age_growth_evals=0)
+        with pytest.raises(ValueError):
+            ScalePolicyConfig(headroom_evals=0)
+
+
+# ---------------------------------------------------------------------------
+# scale-out evidence
+# ---------------------------------------------------------------------------
+
+
+class TestBurnEvidence:
+    def test_both_window_burn_scales_out(self):
+        policy = ScalePolicy(ScalePolicyConfig(min_shards=1, max_shards=8))
+        d = policy.evaluate(snap(time=10.0, burn=burning()))
+        assert d.action == ACTION_OUT and d.reason == REASON_BURN
+        assert d.executed and d.rails == ()
+        assert d.target_shards == 4  # one doubling of 2
+
+    def test_single_window_burn_holds(self):
+        # the multi-window rule: a short spike with a cool long window
+        # (or stale long-window burn with a recovered short window) is
+        # not sustained evidence
+        policy = ScalePolicy()
+        d = policy.evaluate(
+            snap(burn={GA_OBJ: {300.0: 5.0, 3600.0: 0.2}})
+        )
+        assert d.action == ACTION_HOLD and d.reason == REASON_STEADY
+        assert not d.executed
+
+    def test_burn_exactly_at_threshold_trips(self):
+        policy = ScalePolicy(ScalePolicyConfig(burn_threshold=1.0))
+        d = policy.evaluate(snap(burn=burning(1.0)))
+        assert d.action == ACTION_OUT
+
+    def test_empty_burn_windows_are_not_evidence(self):
+        policy = ScalePolicy()
+        d = policy.evaluate(snap(burn={GA_OBJ: {}}))
+        assert d.action == ACTION_HOLD
+
+    def test_max_step_is_one_doubling(self):
+        policy = ScalePolicy(ScalePolicyConfig(max_shards=16))
+        d = policy.evaluate(snap(shard_count=2, burn=burning()))
+        assert d.target_shards == 4  # not 16
+
+    def test_doubling_clamps_to_max(self):
+        policy = ScalePolicy(ScalePolicyConfig(max_shards=6))
+        d = policy.evaluate(snap(shard_count=4, burn=burning()))
+        assert d.target_shards == 6 and d.executed
+
+
+# ---------------------------------------------------------------------------
+# age-growth evidence
+# ---------------------------------------------------------------------------
+
+
+class TestAgeGrowthEvidence:
+    CFG = ScalePolicyConfig(age_growth_evals=3, age_floor_seconds=60.0)
+
+    def test_growing_age_above_floor_scales_out_after_streak(self):
+        policy = ScalePolicy(self.CFG)
+        ages = [70.0, 90.0, 110.0, 130.0]
+        decisions = [
+            policy.evaluate(snap(time=30.0 * i, oldest_age=age, burn=cool()))
+            for i, age in enumerate(ages)
+        ]
+        # first eval has no previous age to compare against
+        assert [d.action for d in decisions[:3]] == [ACTION_HOLD] * 3
+        assert decisions[3].action == ACTION_OUT
+        assert decisions[3].reason == REASON_AGE
+
+    def test_age_below_floor_never_counts(self):
+        policy = ScalePolicy(self.CFG)
+        for i, age in enumerate([10.0, 20.0, 30.0, 40.0, 50.0]):
+            d = policy.evaluate(snap(time=30.0 * i, oldest_age=age))
+        assert d.action == ACTION_HOLD
+        assert d.evidence["age_growth_streak"] == 0
+
+    def test_plateau_resets_the_streak(self):
+        policy = ScalePolicy(self.CFG)
+        for i, age in enumerate([70.0, 90.0, 110.0, 110.0, 130.0]):
+            d = policy.evaluate(snap(time=30.0 * i, oldest_age=age))
+        # plateau at eval 3 reset the streak; eval 4 restarts at 1
+        assert d.action == ACTION_HOLD
+        assert d.evidence["age_growth_streak"] == 1
+
+    def test_open_circuit_voids_age_evidence(self):
+        policy = ScalePolicy(self.CFG)
+        for i, age in enumerate([70.0, 90.0, 110.0, 130.0, 150.0]):
+            d = policy.evaluate(
+                snap(
+                    time=30.0 * i,
+                    oldest_age=age,
+                    open_circuits=["globalaccelerator"],
+                )
+            )
+        assert d.action == ACTION_HOLD
+        assert d.evidence["age_growth_streak"] == 0
+
+
+# ---------------------------------------------------------------------------
+# scale-in evidence
+# ---------------------------------------------------------------------------
+
+
+class TestHeadroomEvidence:
+    CFG = ScalePolicyConfig(
+        min_shards=1, headroom_evals=4, headroom_burn=0.25
+    )
+
+    def test_sustained_headroom_scales_in(self):
+        policy = ScalePolicy(self.CFG)
+        for i in range(4):
+            d = policy.evaluate(
+                snap(time=30.0 * i, shard_count=4, burn=cool())
+            )
+        assert d.action == ACTION_IN and d.reason == REASON_HEADROOM
+        assert d.executed and d.target_shards == 2  # one halving
+
+    def test_warm_burn_resets_the_streak(self):
+        policy = ScalePolicy(self.CFG)
+        burns = [cool(), cool(), cool(), burning(0.5), cool()]
+        for i, b in enumerate(burns):
+            d = policy.evaluate(snap(time=30.0 * i, shard_count=4, burn=b))
+        assert d.action == ACTION_HOLD
+        assert d.evidence["headroom_streak"] == 1
+
+    def test_old_backlog_blocks_headroom(self):
+        policy = ScalePolicy(self.CFG)
+        for i in range(6):
+            d = policy.evaluate(
+                snap(
+                    time=30.0 * i,
+                    shard_count=4,
+                    burn=cool(),
+                    oldest_age=200.0,
+                )
+            )
+        assert d.action == ACTION_HOLD
+
+    def test_halving_clamps_to_min(self):
+        policy = ScalePolicy(ScalePolicyConfig(min_shards=3, headroom_evals=1))
+        d = policy.evaluate(snap(shard_count=4, burn=cool()))
+        assert d.action == ACTION_IN and d.target_shards == 3
+
+
+# ---------------------------------------------------------------------------
+# brownout exclusion
+# ---------------------------------------------------------------------------
+
+
+class TestBrownoutExclusion:
+    def test_open_circuit_excludes_objective_from_burn(self):
+        policy = ScalePolicy()
+        d = policy.evaluate(
+            snap(burn=burning(), open_circuits=["globalaccelerator"])
+        )
+        assert d.action == ACTION_HOLD
+        assert d.evidence["excluded_objectives"] == [GA_OBJ]
+        assert d.evidence["tripped_objectives"] == []
+
+    def test_unrelated_circuit_does_not_exclude(self):
+        policy = ScalePolicy()
+        d = policy.evaluate(snap(burn=burning(), open_circuits=["route53"]))
+        assert d.action == ACTION_OUT
+        assert d.evidence["excluded_objectives"] == []
+
+    def test_other_objectives_still_count_during_a_brownout(self):
+        policy = ScalePolicy()
+        d = policy.evaluate(
+            snap(
+                burn={
+                    GA_OBJ: {300.0: 3.0, 3600.0: 3.0},
+                    R53_OBJ: {300.0: 2.0, 3600.0: 2.0},
+                },
+                objective_services={
+                    GA_OBJ: frozenset(["globalaccelerator"]),
+                    R53_OBJ: frozenset(["route53"]),
+                },
+                open_circuits=["globalaccelerator"],
+            )
+        )
+        assert d.action == ACTION_OUT
+        assert d.evidence["tripped_objectives"] == [R53_OBJ]
+        assert d.evidence["excluded_objectives"] == [GA_OBJ]
+
+    def test_exclusion_holds_after_the_circuit_recloses(self):
+        # the outage's wedged journeys burn the windows AFTER the
+        # restore — the hold keeps the echo from scaling the fleet
+        policy = ScalePolicy(ScalePolicyConfig(brownout_hold_seconds=300.0))
+        policy.evaluate(
+            snap(time=0.0, open_circuits=["globalaccelerator"], burn=cool())
+        )
+        d = policy.evaluate(snap(time=200.0, burn=burning()))
+        assert d.action == ACTION_HOLD
+        assert d.evidence["recently_open_circuits"] == ["globalaccelerator"]
+        assert d.evidence["excluded_objectives"] == [GA_OBJ]
+
+    def test_hold_expires(self):
+        policy = ScalePolicy(ScalePolicyConfig(brownout_hold_seconds=300.0))
+        policy.evaluate(
+            snap(time=0.0, open_circuits=["globalaccelerator"], burn=cool())
+        )
+        d = policy.evaluate(snap(time=301.0, burn=burning()))
+        assert d.action == ACTION_OUT
+        assert d.evidence["recently_open_circuits"] == []
+
+    def test_reopening_extends_the_hold(self):
+        policy = ScalePolicy(ScalePolicyConfig(brownout_hold_seconds=300.0))
+        policy.evaluate(
+            snap(time=0.0, open_circuits=["globalaccelerator"], burn=cool())
+        )
+        policy.evaluate(
+            snap(time=250.0, open_circuits=["globalaccelerator"], burn=cool())
+        )
+        d = policy.evaluate(snap(time=400.0, burn=burning()))
+        assert d.action == ACTION_HOLD  # held until 250 + 300
+
+
+# ---------------------------------------------------------------------------
+# rails
+# ---------------------------------------------------------------------------
+
+
+class TestRails:
+    def test_disabled_rail(self):
+        policy = ScalePolicy(ScalePolicyConfig(enabled=False))
+        d = policy.evaluate(snap(burn=burning()))
+        assert d.action == ACTION_OUT and not d.executed
+        assert RAIL_DISABLED in d.rails
+
+    def test_transition_rail_on_resize_state(self):
+        policy = ScalePolicy()
+        d = policy.evaluate(snap(burn=burning(), resize_state="draining"))
+        assert not d.executed and RAIL_TRANSITION in d.rails
+
+    def test_transition_rail_on_pending_handoffs(self):
+        policy = ScalePolicy()
+        d = policy.evaluate(snap(burn=burning(), handoff_pending=3))
+        assert not d.executed and RAIL_TRANSITION in d.rails
+
+    def test_cooldown_out_after_an_executed_resize(self):
+        policy = ScalePolicy(ScalePolicyConfig(cooldown_out_seconds=120.0))
+        first = policy.evaluate(snap(time=0.0, burn=burning()))
+        assert first.executed
+        d = policy.evaluate(snap(time=60.0, shard_count=4, burn=burning()))
+        assert not d.executed and RAIL_COOLDOWN_OUT in d.rails
+        d = policy.evaluate(snap(time=121.0, shard_count=4, burn=burning()))
+        assert d.executed and d.target_shards == 8
+
+    def test_cooldown_in_outlasts_cooldown_out(self):
+        cfg = ScalePolicyConfig(
+            cooldown_out_seconds=120.0,
+            cooldown_in_seconds=600.0,
+            headroom_evals=1,
+        )
+        policy = ScalePolicy(cfg)
+        assert policy.evaluate(snap(time=0.0, burn=burning())).executed
+        # cooled enough for another scale-out, but not for a scale-in
+        d = policy.evaluate(snap(time=200.0, shard_count=4, burn=cool()))
+        assert d.action == ACTION_IN and not d.executed
+        assert RAIL_COOLDOWN_IN in d.rails
+        d = policy.evaluate(snap(time=601.0, shard_count=4, burn=cool()))
+        assert d.executed
+
+    def test_at_max_rail(self):
+        policy = ScalePolicy(ScalePolicyConfig(max_shards=4))
+        d = policy.evaluate(snap(shard_count=4, burn=burning()))
+        assert d.action == ACTION_OUT and not d.executed
+        assert RAIL_AT_MAX in d.rails and d.target_shards == 4
+
+    def test_at_min_rail(self):
+        policy = ScalePolicy(
+            ScalePolicyConfig(min_shards=2, headroom_evals=1)
+        )
+        d = policy.evaluate(snap(shard_count=2, burn=cool()))
+        assert d.action == ACTION_IN and not d.executed
+        assert RAIL_AT_MIN in d.rails
+
+    def test_observe_only_suppresses_a_clean_desire(self):
+        policy = ScalePolicy(ScalePolicyConfig(observe_only=True))
+        d = policy.evaluate(snap(burn=burning()))
+        assert d.action == ACTION_OUT and not d.executed
+        assert d.rails == (RAIL_OBSERVE_ONLY,)
+        assert d.target_shards == 4  # the recommendation is still real
+
+    def test_observe_only_defers_to_harder_rails(self):
+        # when another rail already suppressed the decision, the label
+        # should name THAT rail, not observe-only
+        policy = ScalePolicy(
+            ScalePolicyConfig(observe_only=True, max_shards=2)
+        )
+        d = policy.evaluate(snap(shard_count=2, burn=burning()))
+        assert d.rails == (RAIL_AT_MAX,)
+
+    def test_hold_carries_no_rails(self):
+        policy = ScalePolicy(ScalePolicyConfig(enabled=False))
+        d = policy.evaluate(snap(burn=cool()))
+        assert d.action == ACTION_HOLD and d.rails == ()
+
+    def test_suppressed_decision_does_not_start_cooldown(self):
+        policy = ScalePolicy(ScalePolicyConfig(observe_only=True))
+        d1 = policy.evaluate(snap(time=0.0, burn=burning()))
+        d2 = policy.evaluate(snap(time=30.0, burn=burning()))
+        assert d1.rails == d2.rails == (RAIL_OBSERVE_ONLY,)
+        assert d2.evidence["since_last_resize_s"] is None
+
+
+# ---------------------------------------------------------------------------
+# state machine bookkeeping
+# ---------------------------------------------------------------------------
+
+
+class TestPolicyState:
+    def test_executed_resize_resets_both_streaks(self):
+        cfg = ScalePolicyConfig(headroom_evals=3)
+        policy = ScalePolicy(cfg)
+        for i in range(2):
+            policy.evaluate(snap(time=30.0 * i, shard_count=4, burn=cool()))
+        d = policy.evaluate(snap(time=60.0, shard_count=4, burn=burning()))
+        assert d.executed
+        # evidence captured the streak as it stood at this evaluation
+        assert d.evidence["headroom_streak"] == 0  # burn broke it
+        d = policy.evaluate(snap(time=300.0, shard_count=8, burn=cool()))
+        assert d.evidence["headroom_streak"] == 1  # restarted from zero
+
+    def test_evidence_schema(self):
+        policy = ScalePolicy()
+        d = policy.evaluate(
+            snap(
+                time=12.3456,
+                burn=burning(1.5),
+                oldest_age=42.0,
+                keys_by_shard={"0": 3, "1": 5},
+            )
+        )
+        ev = d.evidence
+        assert ev["burn"] == {GA_OBJ: {"300s": 1.5, "3600s": 1.5}}
+        assert ev["tripped_objectives"] == [GA_OBJ]
+        assert ev["oldest_unconverged_age_s"] == 42.0
+        assert ev["keys_by_shard"] == {"0": 3, "1": 5}
+        for key in (
+            "burn_threshold", "excluded_objectives", "open_circuits",
+            "recently_open_circuits", "age_growth_streak", "headroom_streak",
+            "resize_state", "handoff_pending", "since_last_resize_s",
+            "cooldown_out_s", "cooldown_in_s", "min_shards", "max_shards",
+        ):
+            assert key in ev
+
+    def test_to_dict_roundtrips_error(self):
+        policy = ScalePolicy()
+        d = policy.evaluate(snap(burn=burning()))
+        assert "error" not in d.to_dict()
+        d.error = "boom"
+        assert d.to_dict()["error"] == "boom"
+
+
+# ---------------------------------------------------------------------------
+# signal collection
+# ---------------------------------------------------------------------------
+
+
+class TestServicesForControllers:
+    def test_route53_prefix_maps_to_route53(self):
+        assert services_for_controllers(
+            ["route53-controller-service"]
+        ) == frozenset(["route53"])
+
+    def test_everything_else_maps_to_ga(self):
+        got = services_for_controllers(
+            ["global-accelerator-controller-service", "endpointgroupbinding"]
+        )
+        assert got == frozenset(["globalaccelerator"])
+
+
+class TestScaleSignals:
+    def test_defaults_without_sources(self):
+        clock = FakeClock(77.0)
+        s = ScaleSignals(clock=clock).collect()
+        assert s.time == 77.0
+        assert s.shard_count == 1 and s.resize_state == "stable"
+        assert s.burn == {} and s.oldest_age == 0.0
+        assert s.open_circuits == frozenset()
+
+    def test_collect_reads_every_source(self):
+        class FakeSLO:
+            objectives = (
+                type(
+                    "Obj", (), {
+                        "name": GA_OBJ,
+                        "controllers": ("global-accelerator-controller-service",),
+                    },
+                )(),
+            )
+
+            @staticmethod
+            def burn_snapshot():
+                return {GA_OBJ: {300.0: 1.5}}
+
+        class FakeJourney:
+            @staticmethod
+            def oldest_unconverged_age():
+                return 33.0
+
+            @staticmethod
+            def inflight():
+                return 7
+
+        s = ScaleSignals(
+            slo_engine=FakeSLO(),
+            journey_tracker=FakeJourney(),
+            resize_status=lambda: {
+                "shard_count": 4, "state": "draining", "handoff_pending": 2,
+            },
+            keys_by_shard=lambda: {"0": 9},
+            replica_count=lambda: 5,
+            open_circuits=lambda: ["route53"],
+            clock=FakeClock(5.0),
+        ).collect()
+        assert s.shard_count == 4 and s.resize_state == "draining"
+        assert s.handoff_pending == 2
+        assert s.burn == {GA_OBJ: {300.0: 1.5}}
+        assert s.objective_services == {
+            GA_OBJ: frozenset(["globalaccelerator"])
+        }
+        assert s.oldest_age == 33.0 and s.inflight == 7
+        assert s.keys_by_shard == {"0": 9} and s.replica_count == 5
+        assert s.open_circuits == frozenset(["route53"])
+
+    def test_broken_sources_degrade_to_defaults(self):
+        def boom():
+            raise RuntimeError("lease read raced a CAS")
+
+        s = ScaleSignals(
+            resize_status=boom,
+            keys_by_shard=boom,
+            replica_count=boom,
+            open_circuits=boom,
+            clock=FakeClock(),
+        ).collect()
+        assert s.shard_count == 1 and s.resize_state == "stable"
+        assert s.keys_by_shard == {} and s.replica_count == 0
+
+    def test_none_shard_count_degrades_to_one(self):
+        s = ScaleSignals(
+            resize_status=lambda: {"shard_count": None},
+            clock=FakeClock(),
+        ).collect()
+        assert s.shard_count == 1
+
+
+# ---------------------------------------------------------------------------
+# the loop
+# ---------------------------------------------------------------------------
+
+
+def make_loop(policy_cfg=None, burn=None, execute="collect", clock=None):
+    clock = clock or FakeClock()
+    calls = []
+    signals = ScaleSignals(
+        resize_status=lambda: {"shard_count": 2, "state": "stable"},
+        clock=clock,
+    )
+    # inject burn by overriding collect-level sources
+    if burn is not None:
+        base_collect = signals.collect
+
+        def collect():
+            s = base_collect()
+            s.burn = burn
+            s.objective_services = {
+                GA_OBJ: frozenset(["globalaccelerator"])
+            }
+            return s
+
+        signals.collect = collect
+    reg = MetricsRegistry()
+    recorder = FlightRecorder(capacity=64, clock=clock)
+    loop = AutoscalerLoop(
+        signals,
+        ScalePolicy(policy_cfg or ScalePolicyConfig()),
+        execute=calls.append if execute == "collect" else execute,
+        registry=reg,
+        flight_recorder=recorder,
+    )
+    return loop, calls, reg, recorder
+
+
+class TestAutoscalerLoop:
+    def test_tick_executes_and_records(self):
+        loop, calls, reg, recorder = make_loop(burn=burning())
+        d = loop.tick()
+        assert d.executed and calls == [4]
+        assert loop.ticks == 1 and loop.executed_total == 1
+        samples = parse_text(reg.render())
+        assert samples["agac_autoscaler_target_shards"] == 4.0
+        assert samples[
+            'agac_autoscaler_decisions_total{action="scale-out",reason="burn"}'
+        ] == 1
+        entries = recorder.dump()
+        assert len(entries) == 1 and entries[0]["kind"] == "autoscale"
+        assert entries[0]["action"] == ACTION_OUT
+        assert entries[0]["evidence"]["tripped_objectives"] == [GA_OBJ]
+
+    def test_every_decision_is_flight_recorded(self):
+        loop, _calls, _reg, recorder = make_loop()  # no burn → holds
+        for _ in range(5):
+            loop.tick()
+        assert recorder.recorded_total == 5
+        assert all(e["action"] == ACTION_HOLD for e in recorder.dump())
+
+    def test_suppression_metric_carries_the_rail(self):
+        loop, calls, reg, _rec = make_loop(
+            policy_cfg=ScalePolicyConfig(observe_only=True), burn=burning()
+        )
+        loop.tick()
+        assert calls == []
+        samples = parse_text(reg.render())
+        assert samples[
+            'agac_autoscaler_suppressed_total{rail="observe-only"}'
+        ] == 1
+
+    def test_observe_only_never_calls_execute(self):
+        def forbidden(_target):
+            raise AssertionError("observe-only must never resize")
+
+        loop, _calls, _reg, recorder = make_loop(
+            policy_cfg=ScalePolicyConfig(observe_only=True),
+            burn=burning(),
+            execute=forbidden,
+        )
+        for _ in range(3):
+            d = loop.tick()
+            assert not d.executed
+        assert loop.executed_total == 0
+        assert recorder.recorded_total == 3
+
+    def test_execute_error_is_captured_not_raised(self):
+        def boom(_target):
+            raise RuntimeError("lease CAS lost")
+
+        loop, _calls, reg, recorder = make_loop(burn=burning(), execute=boom)
+        d = loop.tick()
+        assert not d.executed
+        assert RAIL_EXECUTE_ERROR in d.rails
+        assert d.error == "lease CAS lost"
+        assert loop.executed_total == 0
+        entry = recorder.dump()[0]
+        assert entry["error"] == "lease CAS lost"
+        samples = parse_text(reg.render())
+        assert samples[
+            'agac_autoscaler_suppressed_total{rail="execute-error"}'
+        ] == 1
+
+    def test_failed_execute_still_starts_the_cooldown(self):
+        # a persistently failing resize must not hot-loop the executor
+        clock = FakeClock()
+        loop, _calls, _reg, _rec = make_loop(
+            burn=burning(),
+            execute=lambda _t: (_ for _ in ()).throw(RuntimeError("down")),
+            clock=clock,
+        )
+        loop.tick()
+        clock.advance(30.0)
+        d = loop.tick()
+        assert RAIL_COOLDOWN_OUT in d.rails
+
+    def test_missing_executor_is_an_execute_error(self):
+        loop, _calls, _reg, _rec = make_loop(burn=burning(), execute=None)
+        d = loop.tick()
+        assert not d.executed and RAIL_EXECUTE_ERROR in d.rails
+
+    def test_status_shape(self):
+        loop, _calls, _reg, _rec = make_loop(burn=burning())
+        status = loop.status()
+        assert status["evaluations"] == 0 and "last_decision" not in status
+        loop.tick()
+        status = loop.status()
+        assert status["enabled"] is True
+        assert status["observe_only"] is False
+        assert status["evaluations"] == 1
+        assert status["executed_total"] == 1
+        last = status["last_decision"]
+        assert last["action"] == ACTION_OUT and last["executed"] is True
+        assert last["target_shards"] == 4
+
+    def test_history_is_bounded_and_ordered(self):
+        clock = FakeClock()
+        loop, _calls, _reg, _rec = make_loop(clock=clock)
+        loop._history = type(loop._history)(maxlen=3)
+        for _ in range(5):
+            loop.tick()
+            clock.advance(30.0)
+        hist = loop.history()
+        assert len(hist) == 3
+        times = [h["time"] for h in hist]
+        assert times == sorted(times)
+        assert loop.history(limit=1)[0]["time"] == times[-1]

@@ -1,0 +1,133 @@
+"""Sim-harness tiers for the SLO-driven shard autoscaler (ISSUE 13).
+
+Fast tier (tier-1): the live wiring end-to-end on a quiet fleet — the
+autoscaler evaluates on the virtual scheduler, reads real ring/journey
+/SLO signals, flight-records every decision, reclaims an
+overprovisioned fleet through the real ``request_resize`` CAS path
+(and the transition settles under the full oracle battery), and in
+observe-only mode records the same recommendation without ever
+resizing.
+
+Slow tier (the CI ``sim`` job): the closed-loop scenario battery from
+``sim/fuzz.py`` — the load wave that scales 2→4 and back, the
+brownout that must NOT scale, and the observe-only wave.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from agac_tpu.autoscaler import ACTION_IN, RAIL_OBSERVE_ONLY, ScalePolicyConfig
+from agac_tpu.leaderelection import LeaderElectionConfig
+from agac_tpu.sim import fuzz
+from agac_tpu.sim.harness import SimHarness, SimHarnessConfig
+from agac_tpu.sim.oracles import standard_oracles
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+
+LEASE = LeaderElectionConfig(
+    lease_duration=60.0, renew_deadline=15.0, retry_period=5.0
+)
+
+# scale-in wants 4 quiet evaluations and a short cooldown — a quiet
+# converged fleet reaches that within ~2 virtual minutes
+RECLAIM_POLICY = ScalePolicyConfig(
+    min_shards=2,
+    max_shards=4,
+    headroom_evals=4,
+    age_floor_seconds=60.0,
+    cooldown_out_seconds=60.0,
+    cooldown_in_seconds=60.0,
+)
+
+
+def overprovisioned_config(**overrides) -> SimHarnessConfig:
+    defaults = dict(
+        replicas=4,
+        shard_count=4,
+        shards_per_replica=2,
+        lease=LEASE,
+        autoscale=True,
+        autoscale_interval=15.0,
+        autoscale_policy=RECLAIM_POLICY,
+    )
+    defaults.update(overrides)
+    return SimHarnessConfig(**defaults)
+
+
+def seed_fleet(harness, n: int) -> None:
+    harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+    for i in range(n):
+        harness.cluster.create("Service", make_lb_service(name=f"svc-{i:05d}"))
+
+
+class TestAutoscalerLiveWiring:
+    def test_reclaims_an_overprovisioned_fleet(self):
+        with SimHarness(config=overprovisioned_config()) as harness:
+            seed_fleet(harness, 20)
+            harness.run_for(900.0)
+            assert harness.run_until_quiescent(3600.0, settle_window=60.0)
+
+            status = harness.autoscaler.status()
+            assert status["evaluations"] > 0
+            # every decision was flight-recorded with its evidence
+            assert (
+                harness.autoscaler_recorder.recorded_total
+                == status["evaluations"]
+            )
+            entries = harness.autoscaler_recorder.dump()
+            assert all(e["kind"] == "autoscale" for e in entries)
+            assert all("evidence" in e for e in entries)
+            # the quiet fleet was reclaimed 4→2 through the real CAS
+            # path, and at-min held it there
+            executed = [d for d in harness.autoscaler.history() if d["executed"]]
+            assert executed and executed[0]["action"] == ACTION_IN
+            assert executed[0]["target_shards"] == 2
+            assert harness._resize_requests == [2]
+            assert harness.resize_settled(2), harness.resize_states()
+            assert standard_oracles(harness, harness.config.cluster_name) == []
+
+    def test_observe_only_recommends_but_never_resizes(self):
+        config = overprovisioned_config(
+            autoscale_policy=ScalePolicyConfig(
+                min_shards=2,
+                max_shards=4,
+                headroom_evals=4,
+                age_floor_seconds=60.0,
+                cooldown_out_seconds=60.0,
+                cooldown_in_seconds=60.0,
+                observe_only=True,
+            )
+        )
+        with SimHarness(config=config) as harness:
+            seed_fleet(harness, 20)
+            harness.run_for(900.0)
+            assert harness.run_until_quiescent(3600.0, settle_window=60.0)
+
+            decisions = harness.autoscaler.history()
+            suppressed = [
+                d for d in decisions if RAIL_OBSERVE_ONLY in d["rails"]
+            ]
+            assert suppressed, "no recommendation was ever suppressed"
+            assert suppressed[0]["action"] == ACTION_IN
+            assert not any(d["executed"] for d in decisions)
+            assert harness._resize_requests == []
+            assert harness.resize_settled(4), harness.resize_states()
+            assert standard_oracles(harness, harness.config.cluster_name) == []
+
+
+@pytest.mark.slow
+class TestAutoscalerScenarios:
+    def test_load_wave_scales_out_and_back(self):
+        result = fuzz.run_autoscale_scenario(1, profile="mini")
+        assert result.violations == [], result.violations
+
+    def test_brownout_burn_never_scales_out(self):
+        result = fuzz.run_autoscale_brownout_scenario(1, profile="mini")
+        assert result.violations == [], result.violations
+
+    def test_observe_only_wave_recommends_without_acting(self):
+        result = fuzz.run_autoscale_scenario(
+            1, profile="mini", observe_only=True
+        )
+        assert result.violations == [], result.violations

@@ -244,6 +244,41 @@ def test_sharding_block_exported_and_quota_respected(bench_run, detail_path):
     }
 
 
+def test_autoscaler_reaction_block_exported(bench_run, detail_path):
+    """The SLO-driven autoscaler's reaction benchmark (ISSUE 13): the
+    ``autoscaler`` block carries the spike-to-scale-out and
+    spike-to-restored (scale-back) virtual seconds off the closed-loop
+    sim scenario, and the observe-only twin demonstrably never
+    resized."""
+    with open(detail_path) as f:
+        detail = json.load(f)
+    autoscaler = detail["autoscaler"]
+    for key in (
+        "spike_to_scale_out_s", "spike_to_scale_in_s", "wave_at_s",
+        "decisions", "executed", "observe_only",
+    ):
+        assert key in autoscaler, f"autoscaler block missing {key!r}"
+    # the loop reacted after the spike, within the scenario's budget
+    assert 0 < autoscaler["spike_to_scale_out_s"] <= 450.0
+    # ...and scaled back only after the out (restore follows reaction)
+    assert autoscaler["spike_to_scale_in_s"] > autoscaler["spike_to_scale_out_s"]
+    # exactly one out and one in: the no-oscillation oracle's shape
+    actions = [action for _, action, _ in autoscaler["executed"]]
+    assert actions == ["scale-out", "scale-in"], actions
+    out_target = autoscaler["executed"][0][2]
+    assert out_target == 4, f"first scale-out targeted {out_target}"
+    # the observe-only twin recommended but never acted
+    observe = autoscaler["observe_only"]
+    assert observe["suppressed_recommendations"] >= 1
+    assert observe["executed"] == []
+    # the headline carries the reaction at a glance
+    lines = [ln for ln in bench_run.stdout.splitlines() if ln.strip()]
+    headline = json.loads(lines[-1])
+    assert headline["autoscaler"]["react_s"] == autoscaler["spike_to_scale_out_s"]
+    assert headline["autoscaler"]["restore_s"] == autoscaler["spike_to_scale_in_s"]
+    assert headline["autoscaler"]["observe_resizes"] == 0
+
+
 def test_metrics_snapshot_scraped_per_phase(bench_run, detail_path):
     """The observability plane's bench integration (ISSUE 5): every
     phase ends with a real HTTP scrape of /metrics off the process

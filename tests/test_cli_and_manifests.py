@@ -102,6 +102,129 @@ class TestCLI:
         assert result.returncode == 1
         assert "Error building rest config" in result.stderr
 
+    def test_autoscale_flag_defaults(self):
+        args = build_parser().parse_args(["controller"])
+        assert args.autoscale is False
+        assert args.autoscale_min_shards == 2
+        assert args.autoscale_max_shards == 8
+        assert args.autoscale_cooldown_out == 120.0
+        assert args.autoscale_cooldown_in == 600.0
+        assert args.autoscale_interval == 30.0
+        assert args.autoscale_observe_only is False
+
+    def test_resize_shards_flags(self):
+        args = build_parser().parse_args(["resize-shards", "-n", "4"])
+        assert args.shard_count == 4
+        assert args.force is False
+        assert args.dry_run is False
+
+
+class TestResizeShardsCLI:
+    """run_resize_shards against a stubbed ring lease — the operator
+    surface ISSUE 13 pins: plan printout, no-op refusal, --dry-run."""
+
+    @staticmethod
+    def make_args(**kw):
+        import argparse
+
+        defaults = dict(
+            shard_count=4, kubeconfig="/fake", master="",
+            force=False, dry_run=False,
+        )
+        defaults.update(kw)
+        return argparse.Namespace(**defaults)
+
+    @staticmethod
+    def stub(monkeypatch, status, epoch=7):
+        import agac_tpu.cluster.rest as rest
+        import agac_tpu.sharding as sharding
+
+        calls = []
+        monkeypatch.setattr(rest, "build_client", lambda *a, **k: object())
+        monkeypatch.setattr(sharding, "ring_status", lambda *a, **k: status)
+
+        def fake_request(client, n, namespace="kube-system", force=False):
+            calls.append((n, force))
+            return epoch
+
+        monkeypatch.setattr(sharding, "request_resize", fake_request)
+        return calls
+
+    def test_resize_prints_plan_and_requests(self, monkeypatch, capsys):
+        from agac_tpu.cmd.root import run_resize_shards
+
+        calls = self.stub(
+            monkeypatch,
+            {"shard_count": 2, "epoch": 1, "in_flight": False},
+        )
+        rc = run_resize_shards(self.make_args(shard_count=4))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "transition plan 2 -> 4 shards" in out
+        assert "of the keyspace moves" in out
+        assert "drains to shard(s)" in out
+        assert "epoch 7" in out
+        assert calls == [(4, False)]
+
+    def test_noop_resize_is_refused(self, monkeypatch, capsys):
+        from agac_tpu.cmd.root import run_resize_shards
+
+        calls = self.stub(
+            monkeypatch,
+            {"shard_count": 4, "epoch": 3, "in_flight": False},
+        )
+        rc = run_resize_shards(self.make_args(shard_count=4))
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "already at 4 shards" in err
+        assert calls == []
+
+    def test_dry_run_writes_nothing(self, monkeypatch, capsys):
+        from agac_tpu.cmd.root import run_resize_shards
+
+        calls = self.stub(
+            monkeypatch,
+            {"shard_count": 2, "epoch": 1, "in_flight": False},
+        )
+        rc = run_resize_shards(self.make_args(shard_count=4, dry_run=True))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "transition plan 2 -> 4 shards" in out
+        assert "dry run: ring lease not written" in out
+        assert calls == []
+
+    def test_in_flight_transition_warns_without_force(
+        self, monkeypatch, capsys
+    ):
+        from agac_tpu.cmd.root import run_resize_shards
+
+        self.stub(
+            monkeypatch,
+            {"shard_count": 2, "epoch": 1, "in_flight": True},
+        )
+        rc = run_resize_shards(self.make_args(shard_count=4))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "still in flight" in out
+
+    def test_refused_request_surfaces_the_reason(self, monkeypatch, capsys):
+        import agac_tpu.sharding as sharding
+        from agac_tpu.cmd.root import run_resize_shards
+
+        self.stub(
+            monkeypatch,
+            {"shard_count": 2, "epoch": 1, "in_flight": True},
+        )
+
+        def refuse(*a, **k):
+            raise RuntimeError("transition in flight; use force=True")
+
+        monkeypatch.setattr(sharding, "request_resize", refuse)
+        rc = run_resize_shards(self.make_args(shard_count=4))
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "resize refused: transition in flight" in err
+
 
 class TestManifests:
     def test_crd_matches_reference_shape(self):

@@ -292,6 +292,48 @@ class TestSLOEngine:
 
 
 # ---------------------------------------------------------------------------
+# autoscaler accessors (ISSUE 13) — the stable in-process reads the
+# signal collector consumes
+# ---------------------------------------------------------------------------
+
+
+class TestAutoscalerAccessors:
+    def test_burn_snapshot_empty_before_first_tick(self):
+        engine, _tracker, _clock, _reg = make_engine()
+        assert engine.burn_snapshot() == {}
+
+    def test_burn_snapshot_mirrors_the_last_tick(self):
+        engine, tracker, clock, _reg = make_engine(windows=(100.0, 1000.0))
+        engine.tick()  # baseline sample for the window deltas
+        converge_after(tracker, clock, "ns/slow", 200.0)
+        ticked = engine.tick()
+        snapshot = engine.burn_snapshot()
+        assert snapshot == ticked
+        # keyed by objective name then RAW float window
+        assert set(snapshot["ga_converge_p99"]) == {100.0, 1000.0}
+        assert snapshot["ga_converge_p99"][100.0] > 1.0
+
+    def test_burn_snapshot_is_a_copy(self):
+        engine, tracker, clock, _reg = make_engine(windows=(100.0, 1000.0))
+        converge_after(tracker, clock, "ns/a", 1.0)
+        engine.tick()
+        engine.burn_snapshot()["ga_converge_p99"][100.0] = 999.0
+        assert engine.burn_snapshot()["ga_converge_p99"][100.0] != 999.0
+
+    def test_oldest_unconverged_age_matches_oldest_age(self):
+        tracker, _reg, clock = make_tracker()
+        assert tracker.oldest_unconverged_age() == 0.0
+        tracker.observe_enqueued(GA, "ns/old")
+        clock.advance(45.0)
+        tracker.observe_enqueued(R53, "ns/young")
+        assert tracker.oldest_unconverged_age() == pytest.approx(45.0)
+        assert tracker.oldest_unconverged_age(GA) == pytest.approx(45.0)
+        assert tracker.oldest_unconverged_age(R53) == pytest.approx(0.0)
+        tracker.converged(GA, "ns/old")
+        assert tracker.oldest_unconverged_age() == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
 # fleet merge
 # ---------------------------------------------------------------------------
 
