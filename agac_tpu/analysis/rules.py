@@ -75,6 +75,14 @@ stack — the classes ruff's pyflakes-tier cannot express:
   (``OWNS_ALL``); a genuinely single-process enumeration path carries
   a sanctioned suppression instead.
 
+- ``unattributed-stage`` — ``profile.stage(...)`` calls must pass a
+  literal stage name present in the catalog in
+  ``observability/profile.py`` (ISSUE 14): stage names are metric
+  labels, so a computed name is a cardinality risk and an uncataloged
+  one is CPU the attribution table, docs and bench rails silently
+  never account for.  Dynamic per-AWS-op stages flow through
+  ``profile.api_stage(service, op)`` instead.
+
 Suppression: append ``# agac-lint: ignore[rule-id] -- justification``
 to the offending line.  The justification is mandatory.
 """
@@ -1016,6 +1024,76 @@ def _timer_violation(ctx: LintContext, node: ast.Call) -> Violation:
         "deterministic scheduler — use a seam-driven tick (injected "
         "sleep loop or the sim scheduler's timers) instead",
     )
+
+
+# ---------------------------------------------------------------------------
+# unattributed-stage
+# ---------------------------------------------------------------------------
+
+# literal copy of the stage accountant's catalog
+# (observability/profile.py STAGES) — the linter never imports the
+# package it lints (the RAW_API_OPS precedent), and a sync test pins
+# the two sets equal.  Dynamic per-AWS-op names flow through
+# profile.api_stage(service, op) instead, which this rule does not
+# (and must not) check.
+_STAGE_NAMES = frozenset({
+    "queue-pop",
+    "shard-filter",
+    "informer-lookup",
+    "serialize",
+    "driver-mutate",
+    "settle-park",
+    "self-tax",
+    "drift-tick",
+    "gc-sweep",
+    "r53-batch-flush",
+})
+
+
+def _is_profile_module(ctx: LintContext) -> bool:
+    return "observability" in ctx.path.parts and ctx.path.name == "profile.py"
+
+
+@rule(
+    "unattributed-stage",
+    "profile.stage(...) must be called with a literal stage name from the "
+    "catalog in observability/profile.py — a computed or uncataloged name is "
+    "a metric-label series the attribution table, docs and bench rails never "
+    "account for (the stage-name analogue of unregistered-metric)",
+)
+def check_unattributed_stage(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
+    if _is_profile_module(ctx):
+        return  # the catalog module is where stage() lives
+    imports = ctx.import_map()
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        origin = imports.resolve_call_target(node.func)
+        if origin is None or not origin.endswith("profile.stage"):
+            continue
+        name_arg = node.args[0] if node.args else next(
+            (k.value for k in node.keywords if k.arg == "name"), None
+        )
+        if name_arg is None or not _literal_str(name_arg):
+            yield Violation(
+                "unattributed-stage",
+                str(ctx.path),
+                node.lineno,
+                "profile.stage(...) with a computed stage name — stage names "
+                "are metric labels and must be literal; per-AWS-op names go "
+                "through profile.api_stage(service, op)",
+            )
+            continue
+        if name_arg.value not in _STAGE_NAMES:
+            yield Violation(
+                "unattributed-stage",
+                str(ctx.path),
+                node.lineno,
+                f"profile.stage({name_arg.value!r}) names a stage missing "
+                "from the catalog in observability/profile.py — add it to "
+                "STAGES (with a description) so the attribution table, "
+                "metrics docs and bench rails account for it",
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -16,12 +16,16 @@ The ``unseamed-clock`` lint rule (``analysis/rules.py``) pins the
 invariant statically: a direct wall-clock call outside this module,
 ``agac_tpu/sim/`` and the sanctioned real-I/O modules fails CI.
 
-Three installable pieces:
+Four installable pieces:
 
 - ``monotonic()`` — the interval clock (durations, deadlines, TTLs);
 - ``time()`` — the wall clock (timestamps in persisted objects);
 - ``sleep(d)`` — blocking delay; in the sim this ADVANCES virtual
-  time instead of blocking a thread.
+  time instead of blocking a thread;
+- ``thread_cpu()`` — per-thread CPU time (``time.thread_time``), the
+  stage accountant's cost clock (ISSUE 14).  The sim installs its
+  virtual monotonic here too, so under simulation CPU == wall and the
+  profiling plane stays byte-replayable.
 
 Plus one capability flag: ``threads_enabled()``.  The sim runtime is
 a single-threaded cooperative executor — components that would
@@ -43,10 +47,12 @@ from typing import Callable, Optional
 _real_monotonic = _time.monotonic
 _real_time = _time.time
 _real_sleep = _time.sleep
+_real_thread_cpu = _time.thread_time
 
 _monotonic: Callable[[], float] = _real_monotonic
 _wall: Callable[[], float] = _real_time
 _sleep: Callable[[float], None] = _real_sleep
+_thread_cpu: Callable[[], float] = _real_thread_cpu
 _threads_enabled: bool = True
 
 
@@ -63,6 +69,14 @@ def time() -> float:
 def sleep(seconds: float) -> None:
     """Seam-routed ``time.sleep()``; virtual-time advance in the sim."""
     _sleep(seconds)
+
+
+def thread_cpu() -> float:
+    """Per-thread CPU seconds — the seam-routed ``time.thread_time()``.
+    The stage accountant (``observability/profile.py``) charges every
+    stage's CPU through this; under the sim it reads virtual monotonic
+    time so replay hashes never depend on host scheduling."""
+    return _thread_cpu()
 
 
 def monotonic_fn() -> Callable[[], float]:
@@ -92,13 +106,23 @@ def install(
     wall: Optional[Callable[[], float]] = None,
     sleep: Optional[Callable[[float], None]] = None,
     threads: bool = True,
+    thread_cpu: Optional[Callable[[], float]] = None,
 ) -> None:
     """Install a replacement clock (the sim runtime's entry point).
-    Omitted pieces keep the real implementation."""
-    global _monotonic, _wall, _sleep, _threads_enabled
+    Omitted pieces keep the real implementation — EXCEPT ``thread_cpu``,
+    which defaults to the installed ``monotonic`` whenever that is
+    replaced: a virtual world has no meaningful host-CPU counter, and
+    CPU == wall keeps stage accounting deterministic under replay."""
+    global _monotonic, _wall, _sleep, _thread_cpu, _threads_enabled
     _monotonic = monotonic if monotonic is not None else _real_monotonic
     _wall = wall if wall is not None else _real_time
     _sleep = sleep if sleep is not None else _real_sleep
+    if thread_cpu is not None:
+        _thread_cpu = thread_cpu
+    elif monotonic is not None:
+        _thread_cpu = monotonic
+    else:
+        _thread_cpu = _real_thread_cpu
     _threads_enabled = threads
 
 

@@ -41,7 +41,7 @@ from typing import Callable, Optional
 
 from ... import clockseam, klog
 from ...analysis import racecheck
-from ...observability import instruments
+from ...observability import instruments, profile
 from .errors import AWSAPIError
 from .types import Change
 
@@ -250,6 +250,20 @@ class ChangeBatcher:
         self._m_flushes[reason].inc()
 
     def _commit_batch(
+        self,
+        zone_id: str,
+        tickets: list[BatchTicket],
+        commit: CommitFn,
+        fold: Optional[FoldFn],
+        invalidate: Optional[InvalidateFn],
+        reason: str,
+    ) -> None:
+        with profile.stage("r53-batch-flush"):
+            self._commit_batch_inner(
+                zone_id, tickets, commit, fold, invalidate, reason
+            )
+
+    def _commit_batch_inner(
         self,
         zone_id: str,
         tickets: list[BatchTicket],

@@ -182,6 +182,29 @@ def build_parser() -> argparse.ArgumentParser:
         "0 (default) disables tracing.",
     )
     controller.add_argument(
+        "--profile-hz", type=float, default=0.0,
+        help="Continuous sampling-profiler rate (samples/second): a "
+        "daemon thread walks every thread's stack at this rate and "
+        "folds the samples; the top table goes to the log on SIGTERM "
+        "and /debug/profile?seconds=N serves on-demand captures. "
+        "0 (default) disables the continuous sampler (on-demand "
+        "captures still work).",
+    )
+    controller.add_argument(
+        "--profile-stages", dest="profile_stages", action="store_true",
+        default=True,
+        help="Per-stage CPU/wall attribution for the reconcile hot "
+        "path (queue-pop, shard-filter, informer-lookup, serialize, "
+        "driver-mutate, settle-park, self-tax, ...), exported as "
+        "agac_profile_stage_* histograms. On by default.",
+    )
+    controller.add_argument(
+        "--no-profile-stages", dest="profile_stages",
+        action="store_false",
+        help="Disable the stage accountant (drops the "
+        "agac_profile_stage_* attribution).",
+    )
+    controller.add_argument(
         "--slo-eval-interval", type=float, default=15.0,
         help="Seconds between convergence-SLO engine evaluations "
         "(journey-latency burn rates over the 5m/1h windows; sustained "
@@ -450,9 +473,17 @@ def run_controller(args) -> int:
         aimd_qps=args.api_health_aimd_qps,
     )
     from ..observability import metrics as obs_metrics
+    from ..observability import profile as obs_profile
+    from ..observability import stackprof as obs_stackprof
     from ..observability import trace as obs_trace
 
     obs_trace.configure(args.trace_sample)
+    obs_profile.configure(stages=args.profile_stages)
+    if args.profile_hz > 0:
+        # continuous sampling profiler (ISSUE 14): folds stacks in the
+        # background; SIGTERM dumps the top table with the post-mortem
+        obs_stackprof.configure(args.profile_hz)
+        obs_stackprof.profiler().start(stop)
     tracker = shared_health_tracker()
     manager = Manager(health=tracker, metrics_registry=obs_metrics.registry())
     # reshard adoptions re-read AWS through fresh snapshots (ISSUE 8)

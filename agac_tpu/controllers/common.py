@@ -21,6 +21,7 @@ from ..cloudprovider.aws.health import CircuitOpenError
 from ..cluster.informer import Tombstone
 from ..cluster.objects import meta_namespace_key
 from ..observability import journey as obs_journey
+from ..observability import profile as obs_profile
 from ..observability import slo as obs_slo
 from ..reconcile import RateLimitingQueue, Result, process_next_work_item
 
@@ -147,8 +148,10 @@ def with_shard_guard(shard_filter, process):
         return process
 
     def guarded(arg):
-        key = arg if isinstance(arg, str) else meta_namespace_key(arg)
-        if not shard_filter.owns_key(key):
+        with obs_profile.stage("shard-filter"):
+            key = arg if isinstance(arg, str) else meta_namespace_key(arg)
+            owned = shard_filter.owns_key(key)
+        if not owned:
             return Result(skip=True)
         return process(arg)
 

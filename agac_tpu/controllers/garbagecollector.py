@@ -55,6 +55,7 @@ from .. import klog
 from ..cloudprovider.aws.driver import OWNER_TAG_KEY, accelerator_owner_tag_value
 from ..errors import NotFoundError
 from ..observability import instruments, recorder
+from ..observability import profile as obs_profile
 from ..observability import slo as obs_slo
 from ..observability.metrics import MetricsRegistry
 from ..sharding import OWNS_ALL
@@ -465,7 +466,11 @@ class GarbageCollector:
         )
         while not stop.wait(self._config.interval):
             try:
-                self.sweep_once()
+                # stage accountant (ISSUE 14): the threaded loop's
+                # sweeps are attributed like the explicit
+                # Manager.gc_sweep path
+                with obs_profile.stage("gc-sweep"):
+                    self.sweep_once()
             except Exception as err:  # a bad sweep must not kill the loop
                 klog.errorf("gc sweep failed: %s", err)
         klog.info("Shutting down garbage collector")

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
@@ -43,7 +44,9 @@ from .cluster import ClusterClient, SharedInformerFactory
 from .observability import fleet as obs_fleet
 from .observability import journey as obs_journey
 from .observability import metrics as obs_metrics
+from .observability import profile as obs_profile
 from .observability import recorder as obs_recorder
+from .observability import stackprof as obs_stackprof
 from .observability import slo as obs_slo
 from .controllers import (
     EndpointGroupBindingConfig,
@@ -574,32 +577,36 @@ class Manager:
             klog.warningf("drift tick: shed under SLO budget burn")
             return 0
         enqueued = 0
-        for name, controller in self.controllers.items():
-            open_services = (
-                [
-                    service
-                    for service in getattr(controller, "DRIFT_SERVICES", ())
-                    if self._health.is_open(service)
-                ]
-                if self._health is not None
-                else []
-            )
-            if open_services:
-                report["skipped"][name] = open_services
-                report["partial"] = True
-                klog.warningf(
-                    "drift tick: skipping %s (open circuits: %s)",
-                    name, ", ".join(open_services),
+        # the fleet-enumeration cost of a verify round, attributed as
+        # its own stage (ISSUE 14) — the tick runs outside any
+        # reconcile scope, so it flushes immediately under "manager"
+        with obs_profile.stage("drift-tick"):
+            for name, controller in self.controllers.items():
+                open_services = (
+                    [
+                        service
+                        for service in getattr(controller, "DRIFT_SERVICES", ())
+                        if self._health.is_open(service)
+                    ]
+                    if self._health is not None
+                    else []
                 )
-                continue
-            count = 0
-            for lister, predicate, enqueue in controller.drift_resync_sources():
-                for obj in lister.list():
-                    if predicate(obj):
-                        enqueue(obj)
-                        count += 1
-            report["enqueued"][name] = count
-            enqueued += count
+                if open_services:
+                    report["skipped"][name] = open_services
+                    report["partial"] = True
+                    klog.warningf(
+                        "drift tick: skipping %s (open circuits: %s)",
+                        name, ", ".join(open_services),
+                    )
+                    continue
+                count = 0
+                for lister, predicate, enqueue in controller.drift_resync_sources():
+                    for obj in lister.list():
+                        if predicate(obj):
+                            enqueue(obj)
+                            count += 1
+                report["enqueued"][name] = count
+                enqueued += count
         self.last_drift_reports[report["shards"]] = report
         obs_recorder.flight_recorder().record(
             "drift-tick",
@@ -639,7 +646,8 @@ class Manager:
         the sweeper is disabled."""
         if self.gc is None:
             return {}
-        return self.gc.sweep_once()
+        with obs_profile.stage("gc-sweep"):
+            return self.gc.sweep_once()
 
     def gc_status(self) -> dict:
         """The sweeper's counters for ``/healthz`` and bench_detail:
@@ -699,6 +707,11 @@ class _HealthHandler(BaseHTTPRequestHandler):
             return
         if self.path == "/debug/autoscaler":
             self._autoscaler()
+            return
+        # /debug/profile carries a query string (?seconds=N&format=...),
+        # so it dispatches on the bare path, not an exact match
+        if self.path.split("?", 1)[0] == "/debug/profile":
+            self._profile()
             return
         self.send_error(404)
 
@@ -801,6 +814,36 @@ class _HealthHandler(BaseHTTPRequestHandler):
             },
         )
 
+    def _profile(self):
+        """On-demand sampling-profiler capture (ISSUE 14):
+        ``?seconds=N`` samples the live process for N seconds (bounded
+        by the profiler) and returns the folded stacks plus the ranked
+        top table; ``&format=folded`` returns the flamegraph-ready
+        text instead of JSON.  The stage accountant's cumulative
+        attribution table rides along so one curl answers both "where
+        is wall time going right now" and "where has CPU gone since
+        start"."""
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlparse(self.path).query
+        )
+        try:
+            seconds = float(query.get("seconds", ["1"])[0])
+            hz = float(query.get("hz", ["0"])[0]) or None
+        except ValueError:
+            self._respond(400, {"error": "seconds/hz must be numbers"})
+            return
+        capture = self.server.profile_capture(seconds, hz)
+        if query.get("format", [""])[0] == "folded":
+            payload = (capture["folded"] + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        capture["stages"] = obs_profile.attribution_table()
+        self._respond(200, capture)
+
     def _respond(self, code: int, body: dict):
         payload = json.dumps(body).encode()
         self.send_response(code)
@@ -825,6 +868,7 @@ def make_health_server(
     queue_status: Optional[Callable[[], dict]] = None,
     autoscaler_status: Optional[Callable[[], dict]] = None,
     autoscaler_history: Optional[Callable[[], list]] = None,
+    profile_capture: Optional[Callable[..., dict]] = None,
 ) -> ThreadingHTTPServer:
     """Build the manager's health endpoint (bind port 0 in tests);
     call ``serve_forever`` on a daemon thread to serve.  ``gc_status``
@@ -847,6 +891,7 @@ def make_health_server(
     server.slo_status = slo_status or obs_slo.status_or_disabled
     server.autoscaler_status = autoscaler_status or (lambda: {"enabled": False})
     server.autoscaler_history = autoscaler_history or (lambda: [])
+    server.profile_capture = profile_capture or obs_stackprof.capture
     server.metrics_registry = (
         metrics_registry if metrics_registry is not None else obs_metrics.registry()
     )

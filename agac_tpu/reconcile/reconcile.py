@@ -31,7 +31,7 @@ from typing import Any, Callable
 from .. import clockseam, klog
 from ..cloudprovider.aws import health as api_health
 from ..errors import NoRetryError, NotFoundError, is_no_retry
-from ..observability import instruments, journey, recorder, trace
+from ..observability import instruments, journey, profile, recorder, trace
 from .pending import SettleWait
 from .result import Result
 from .workqueue import RateLimitingQueue
@@ -114,7 +114,13 @@ def process_next_work_item(
     the backend's retry backoffs consult — expiry surfaces as the
     retryable DeadlineExceeded instead of a wedged worker.
     """
-    item, shutdown = queue.get()
+    controller = _controller_name()
+    # stage accountant (ISSUE 14): the pop is charged outside the
+    # reconcile scope — its wall time is dominated by idle queue wait,
+    # which would drown the per-item cpu/wall ratio; its CPU side is
+    # the pop bookkeeping itself
+    with profile.stage("queue-pop", controller=controller):
+        item, shutdown = queue.get()
     if shutdown:
         return False
     heartbeats = api_health.worker_heartbeats()
@@ -126,28 +132,30 @@ def process_next_work_item(
     # driver hooks) ride a thread-local — unsampled items carry None
     # and every tracing call site degrades to a no-op
     tracer = trace.tracer()
-    item_trace = tracer.start(
-        _controller_name(),
-        item if isinstance(item, str) else repr(item),
-        queue_wait=getattr(queue, "last_pop_wait", lambda: None)(),
-    )
-    try:
-        with trace.activate(item_trace):
-            _reconcile_handler(
-                item, queue, key_to_obj, process_delete, process_create_or_update,
-                on_sync_result,
-            )
-    except Exception as err:  # containment: a bad item must not kill the worker
-        klog.errorf("unhandled error reconciling %r: %s", item, err)
-    finally:
-        tracer.finish(item_trace)
-        if item_trace is not None:
-            instruments.reconcile_instruments().traces_sampled.labels(
-                controller=item_trace.controller
-            ).inc()
-        api_health.clear_reconcile_deadline()
-        heartbeats.done()
-        queue.done(item)
+    with profile.reconcile_scope(controller):
+        item_trace = tracer.start(
+            controller,
+            item if isinstance(item, str) else repr(item),
+            queue_wait=getattr(queue, "last_pop_wait", lambda: None)(),
+        )
+        try:
+            with trace.activate(item_trace):
+                _reconcile_handler(
+                    item, queue, key_to_obj, process_delete,
+                    process_create_or_update, on_sync_result,
+                )
+        except Exception as err:  # containment: a bad item must not kill the worker
+            klog.errorf("unhandled error reconciling %r: %s", item, err)
+        finally:
+            with profile.stage("self-tax"):
+                tracer.finish(item_trace)
+                if item_trace is not None:
+                    instruments.reconcile_instruments().traces_sampled.labels(
+                        controller=item_trace.controller
+                    ).inc()
+            api_health.clear_reconcile_deadline()
+            heartbeats.done()
+            queue.done(item)
     return True
 
 
@@ -168,9 +176,10 @@ def _reconcile_handler(
     # journey's id BEFORE the result branches below can close it — the
     # flight-recorder entry must carry the id either way, so a slow
     # convergence in /slo is one grep away from its recorded attempts
-    journeys = journey.tracker()
-    journeys.attempt(controller, key)
-    journey_id = journeys.journey_id(controller, key)
+    with profile.stage("self-tax"):
+        journeys = journey.tracker()
+        journeys.attempt(controller, key)
+        journey_id = journeys.journey_id(controller, key)
     start = clockseam.monotonic()
     try:
         with trace.span("sync"):
@@ -183,8 +192,9 @@ def _reconcile_handler(
     if _sync_duration_observers:
         _observe_sync_duration(key, elapsed, err)
 
-    reconcile_metrics = instruments.reconcile_instruments()
-    reconcile_metrics.duration.labels(controller=controller).observe(elapsed)
+    with profile.stage("self-tax"):
+        reconcile_metrics = instruments.reconcile_instruments()
+        reconcile_metrics.duration.labels(controller=controller).observe(elapsed)
 
     if isinstance(err, SettleWait) and err.table is not None:
         # the async mutation pipeline (ISSUE 6): the handler reached an
@@ -194,7 +204,8 @@ def _reconcile_handler(
         # a failure: backoff state is untouched, and the sync-result
         # hook sees a clean pass so failure streaks reset.
         result = instruments.RESULT_PARKED
-        err.table.park(key, queue, err, controller=controller)
+        with profile.stage("settle-park"):
+            err.table.park(key, queue, err, controller=controller)
         journeys.stage(controller, key, journey.STAGE_PARKED)
         klog.v(2).infof("Parked %r: %s", key, err)
         _notify(on_sync_result, key, None, 0, False)
@@ -250,21 +261,28 @@ def _reconcile_handler(
         klog.infof("Successfully synced %r", key)
         _notify(on_sync_result, key, None, 0, False)
 
-    reconcile_metrics.results.labels(controller=controller, result=result).inc()
-    active_trace = trace.current()
-    if active_trace is not None:
-        active_trace.annotate(
-            result=result, error=str(err) if err is not None else None
+    with profile.stage("self-tax"):
+        reconcile_metrics.results.labels(controller=controller, result=result).inc()
+        active_trace = trace.current()
+        if active_trace is not None:
+            # a sampled trace answers "where did this reconcile's time
+            # go" on its own: journey id for the /slo drill-down plus
+            # the stage-CPU breakdown closed so far (ISSUE 14)
+            active_trace.annotate(
+                result=result,
+                error=str(err) if err is not None else None,
+                journey=journey_id or "",
+                stage_cpu_us=profile.current_scope().breakdown_us(),
+            )
+        recorder.flight_recorder().record(
+            "reconcile",
+            controller=controller,
+            key=key,
+            result=result,
+            duration=round(elapsed, 4),
+            error=str(err) if err is not None else "",
+            journey=journey_id or "",
         )
-    recorder.flight_recorder().record(
-        "reconcile",
-        controller=controller,
-        key=key,
-        result=result,
-        duration=round(elapsed, 4),
-        error=str(err) if err is not None else "",
-        journey=journey_id or "",
-    )
 
 
 def _notify(hook, key, err, requeues, permanent) -> None:
@@ -286,10 +304,12 @@ def _dispatch(
     journey plane close a finished teardown as ``deleted`` rather than
     ``converged``."""
     try:
-        obj = key_to_obj(key)
+        with profile.stage("informer-lookup"):
+            obj = key_to_obj(key)
     except NotFoundError:
         try:
-            return process_delete(key), None, True
+            with profile.stage("driver-mutate"):
+                return process_delete(key), None, True
         except Exception as err:
             return Result(), err, True
     except Exception as err:
@@ -305,6 +325,9 @@ def _dispatch(
     try:
         # DeepCopy before mutation: the cache/lister owns ``obj``
         # (reference ``pkg/reconcile/reconcile.go:67``).
-        return process_create_or_update(copy.deepcopy(obj)), None, False
+        with profile.stage("serialize"):
+            obj_copy = copy.deepcopy(obj)
+        with profile.stage("driver-mutate"):
+            return process_create_or_update(obj_copy), None, False
     except Exception as err:
         return Result(), err, False

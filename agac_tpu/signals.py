@@ -35,6 +35,14 @@ def setup_signal_handler() -> threading.Event:
             flight_recorder().log_dump()
         except Exception:
             pass
+        # continuous-profiling tail (ISSUE 14): whatever the sampler
+        # accumulated rides out with the post-mortem — same containment.
+        try:
+            from .observability.stackprof import profiler
+
+            profiler().log_top()
+        except Exception:
+            pass
         stop.set()
 
     signal.signal(signal.SIGINT, handler)

@@ -74,6 +74,8 @@ from agac_tpu import klog
 from agac_tpu.observability import fleet as obs_fleet
 from agac_tpu.observability import journey as obs_journey
 from agac_tpu.observability import metrics as obs_metrics
+from agac_tpu.observability import profile as obs_profile
+from agac_tpu.observability import stackprof as obs_stackprof
 from agac_tpu.cloudprovider.aws.cache import (
     AcceleratorTopologyCache,
     DiscoveryCache,
@@ -163,6 +165,18 @@ LATENCY_SCALE = 10.0
 # call cut against the 5 req/s Route53 quota.
 R53_BATCH_MAX = int(os.environ.get("AGAC_BENCH_R53_BATCH_MAX", "100"))
 R53_BATCH_LINGER = float(os.environ.get("AGAC_BENCH_R53_LINGER", "1.2"))
+
+# profiling phase fleet size (ISSUE 14): big enough that throughput is
+# genuinely quota-bound (so the control-vs-profiled comparison measures
+# the profiler, not scheduler noise), small enough not to double the
+# bench's wall time
+PROFILE_N = int(os.environ.get("AGAC_BENCH_PROFILE_N", "200"))
+# the in-bench regression gate: with the stage accountant AND the
+# sampling profiler both armed, the headline may not fall more than
+# this many percent below the unprofiled control run
+PROFILE_MAX_OVERHEAD_PCT = float(
+    os.environ.get("AGAC_BENCH_PROFILE_MAX_OVERHEAD", "5.0")
+)
 SETTLE_POLL = 0.2
 
 # Real-world control-plane latencies (seconds) before scaling.
@@ -1793,6 +1807,116 @@ def run_autoscaler_phase() -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# profiling phase (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def run_profiling_phase() -> dict:
+    """The continuous-profiling plane measured against itself: the
+    same tuned convergence workload runs twice — once with the stage
+    accountant disabled (control), once with the accountant armed AND
+    the sampling profiler walking every stack at its default hz — and
+    the profiled run must hold within ``PROFILE_MAX_OVERHEAD_PCT`` of
+    the control's objects/s.  The profiled run's exclusive-time
+    attribution table (per-stage CPU/wall + ns/reconcile) and the
+    sampler's folded top table go to bench_detail; the table must name
+    the production hot-path stages or the accountant has come unwired
+    from the reconcile loop."""
+    kwargs = dict(
+        workers=TUNED_WORKERS,
+        cache_ttl=30.0,
+        zone_cache_ttl=60.0,
+        qps=1000.0,
+        burst=1000,
+        read_plane_ttl=15.0,
+        pipeline=True,
+    )
+    _progress(f"profiling: control run ({PROFILE_N} services, accountant off)")
+    obs_profile.configure(stages=False)
+    try:
+        control = run_convergence(PROFILE_N, **kwargs)
+    finally:
+        obs_profile.configure(stages=True)
+    _progress(
+        f"profiling: profiled run (accountant on + sampler at "
+        f"{obs_stackprof.DEFAULT_HZ:g} hz)"
+    )
+    obs_profile.reset_aggregate()
+    sampler_stop = threading.Event()
+    sampler = obs_stackprof.StackProfiler()
+    sampler_thread = sampler.start(sampler_stop)
+    try:
+        profiled = run_convergence(PROFILE_N, **kwargs)
+    finally:
+        sampler_stop.set()
+        if sampler_thread is not None:
+            sampler_thread.join(timeout=5.0)
+    snap = obs_profile.aggregate_snapshot()
+    table = obs_profile.attribution_table()
+    overhead_pct = round(
+        max(
+            0.0,
+            (control["objects_per_sec"] - profiled["objects_per_sec"])
+            / max(control["objects_per_sec"], 1e-9)
+            * 100.0,
+        ),
+        2,
+    )
+    # the named production stages the attribution table must carry —
+    # aws:* per-op stages ride on top of these
+    stages_seen = sorted(
+        row["stage"] for row in table
+        if not row["stage"].startswith(obs_profile.API_STAGE_PREFIX)
+    )
+    if len(stages_seen) < 5:
+        raise SystemExit(
+            f"profiling phase: attribution table names only {stages_seen} — "
+            "the stage accountant has come unwired from the reconcile hot "
+            "path (expected queue-pop/informer-lookup/serialize/"
+            "driver-mutate/self-tax at minimum)"
+        )
+    # the overhead gate is only meaningful once throughput is genuinely
+    # quota-bound (same doctrine as the ga_mutate floor assertion):
+    # tiny smoke fleets never leave the burst and are all noise
+    quota_bound = profiled["ga_mutate_calls"] > 2 * QUOTAS["ga_mutate"][1]
+    if quota_bound and overhead_pct > PROFILE_MAX_OVERHEAD_PCT:
+        raise SystemExit(
+            f"profiling phase: profiler overhead {overhead_pct}% exceeds the "
+            f"{PROFILE_MAX_OVERHEAD_PCT}% gate (control "
+            f"{control['objects_per_sec']} obj/s vs profiled "
+            f"{profiled['objects_per_sec']} obj/s) — a hot-path stage has "
+            "grown real cost; see profile.table in bench_detail.json"
+        )
+    total_cpu = sum(row["cpu_seconds"] for row in table)
+    reconciles = snap["reconciles"]
+    reconcile_cpu_us = int(total_cpu / max(1, reconciles) * 1e6)
+    _progress(
+        f"profiling: overhead {overhead_pct}% "
+        f"({'gated' if quota_bound else 'reported only — not quota-bound'}), "
+        f"{reconcile_cpu_us} us CPU/reconcile across {len(table)} stages"
+    )
+    sampler_top = sampler.aggregate.top(10)
+    return {
+        "n_services": PROFILE_N,
+        "control_objects_per_sec": control["objects_per_sec"],
+        "profiled_objects_per_sec": profiled["objects_per_sec"],
+        "overhead_pct": overhead_pct,
+        "overhead_gated": quota_bound,
+        "max_overhead_pct": PROFILE_MAX_OVERHEAD_PCT,
+        "reconciles": reconciles,
+        "reconcile_cpu_us": reconcile_cpu_us,
+        "stages_seen": stages_seen,
+        # exclusive-time ranking: every row's cpu excludes its
+        # children, so the column sums to the measured total
+        "table": table,
+        "sampler": {
+            "hz": sampler.hz,
+            "samples": sampler.aggregate.samples,
+            "top": sampler_top,
+        },
+    }
+
+
 def main():
     klog.init(verbosity=-1)
     import logging
@@ -1867,6 +1991,13 @@ def main():
     drift = run_drift_tick(DRIFT_N, workers=TUNED_WORKERS)
     drift["metrics_snapshot"] = scrape_metrics(metrics_port)
     _progress(f"drift tick: {drift['aws_calls_total']} AWS calls/tick")
+    # the continuous-profiling plane measured against itself (ISSUE 14):
+    # control vs profiled twin runs, the overhead gate, and the ranked
+    # per-stage CPU attribution table
+    _progress(
+        f"profiling: control-vs-profiled twin runs over {PROFILE_N} services"
+    )
+    profiling = run_profiling_phase()
     # the horizontal sharding phase (ISSUE 8): real subprocesses, so it
     # runs last — its processes must not share this process's registry
     sharding = run_sharding_phase()
@@ -1905,6 +2036,10 @@ def main():
         "pending_settle": pending_settle,
         "r53_batching": r53_batching,
         "drift_tick": drift,
+        # the continuous-profiling plane's self-measurement (ISSUE 14):
+        # overhead gate result, per-stage exclusive CPU/wall attribution
+        # with ns/reconcile rails, and the sampler's folded top table
+        "profile": profiling,
         # the 2-shard multi-process phase (ISSUE 8): single-shard
         # headline vs two concurrently-live replicas, with quota
         # division asserted
@@ -1977,6 +2112,14 @@ def main():
             "react_s": autoscaler["spike_to_scale_out_s"],
             "restore_s": autoscaler["spike_to_scale_in_s"],
             "observe_resizes": len(autoscaler["observe_only"]["executed"]),
+        },
+        # the continuous-profiling plane at a glance (ISSUE 14): the
+        # hottest attributed stage, exclusive CPU per reconcile, and
+        # the measured profiler overhead vs the unprofiled control
+        "profile": {
+            "top_stage": profiling["table"][0]["stage"] if profiling["table"] else "",
+            "reconcile_cpu_us": profiling["reconcile_cpu_us"],
+            "overhead_pct": profiling["overhead_pct"],
         },
         # fleet-merged convergence SLO signals (ISSUE 9): per-kind
         # journey p99 of the tuned phase (through the fleet-merge

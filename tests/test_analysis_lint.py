@@ -1067,6 +1067,101 @@ class TestJourneyStageWithoutStamp:
         )
 
 
+# ---------------------------------------------------------------------------
+# unattributed-stage
+# ---------------------------------------------------------------------------
+
+
+class TestUnattributedStage:
+    def test_uncataloged_stage_name_fires_once(self):
+        v = only(
+            run(
+                """
+                from agac_tpu.observability import profile
+
+                def tick():
+                    with profile.stage("my-new-hotpath"):
+                        pass
+                """,
+                path="agac_tpu/controllers/bad.py",
+            ),
+            "unattributed-stage",
+        )
+        assert "my-new-hotpath" in v.message and "STAGES" in v.message
+
+    def test_computed_stage_name_fires_once(self):
+        v = only(
+            run(
+                """
+                from agac_tpu.observability import profile
+
+                def tick(name):
+                    with profile.stage(f"dyn-{name}"):
+                        pass
+                """,
+                path="agac_tpu/manager.py",
+            ),
+            "unattributed-stage",
+        )
+        assert "computed" in v.message and "api_stage" in v.message
+
+    def test_cataloged_literal_is_clean(self):
+        assert (
+            run(
+                """
+                from agac_tpu.observability import profile as obs_profile
+
+                def tick():
+                    with obs_profile.stage("drift-tick"):
+                        pass
+                """,
+                path="agac_tpu/manager.py",
+            )
+            == []
+        )
+
+    def test_api_stage_carries_the_dynamic_family(self):
+        # per-AWS-op names are namespaced by api_stage on purpose; the
+        # rule must not flag the sanctioned dynamic path
+        assert (
+            run(
+                """
+                from agac_tpu.observability import profile
+
+                def observed(service, op):
+                    with profile.api_stage(service, op):
+                        pass
+                """,
+                path="agac_tpu/observability/instruments.py",
+            )
+            == []
+        )
+
+    def test_unrelated_stage_functions_stay_out_of_scope(self):
+        # provenance keeps e.g. a theatrical `stage()` helper unflagged
+        assert (
+            run(
+                """
+                from agac_tpu.sim.theatre import stage
+
+                def play():
+                    with stage("curtain-up"):
+                        pass
+                """,
+                path="agac_tpu/controllers/good.py",
+            )
+            == []
+        )
+
+    def test_stage_catalog_matches_the_accountant(self):
+        # the rule's literal copy (the linter never imports the linted
+        # package) must track the accountant's catalog exactly
+        from agac_tpu.analysis.rules import _STAGE_NAMES
+        from agac_tpu.observability import profile
+
+        assert _STAGE_NAMES == frozenset(profile.STAGES)
+
+
 def test_rule_registry_ships_the_documented_rules():
     ids = {r.id for r in RULES}
     assert ids == {
@@ -1083,6 +1178,7 @@ def test_rule_registry_ships_the_documented_rules():
         "unseamed-clock",
         "cross-shard-sweep",
         "journey-stage-without-stamp",
+        "unattributed-stage",
     }
 
 

@@ -40,6 +40,11 @@ TINY_ENV = {
     "AGAC_BENCH_SHARD_N": "10",
     "AGAC_BENCH_SHARD_LATENCY": "0.05",
     "AGAC_BENCH_SHARD_WIDTHS": "1,2",
+    # profiling phase (ISSUE 14): a tiny control/profiled twin pair;
+    # the ≤5% overhead gate only arms once the run is quota-bound, so
+    # the smoke exercises the plumbing and the full-scale bench
+    # enforces the gate
+    "AGAC_BENCH_PROFILE_N": "10",
 }
 
 
@@ -277,6 +282,54 @@ def test_autoscaler_reaction_block_exported(bench_run, detail_path):
     assert headline["autoscaler"]["react_s"] == autoscaler["spike_to_scale_out_s"]
     assert headline["autoscaler"]["restore_s"] == autoscaler["spike_to_scale_in_s"]
     assert headline["autoscaler"]["observe_resizes"] == 0
+
+
+def test_profiling_block_exported(bench_run, detail_path):
+    """The continuous-profiling plane's bench phase (ISSUE 14): the
+    ``profile`` block carries the control-vs-profiled overhead
+    measurement and the ranked exclusive-CPU attribution table with
+    per-stage ns/reconcile rails; the headline surfaces the hottest
+    stage, CPU per reconcile and the overhead percentage."""
+    with open(detail_path) as f:
+        detail = json.load(f)
+    profiling = detail["profile"]
+    for key in (
+        "control_objects_per_sec", "profiled_objects_per_sec",
+        "overhead_pct", "overhead_gated", "max_overhead_pct",
+        "reconciles", "reconcile_cpu_us", "stages_seen", "table",
+        "sampler",
+    ):
+        assert key in profiling, f"profile block missing {key!r}"
+    assert profiling["control_objects_per_sec"] > 0
+    assert profiling["profiled_objects_per_sec"] > 0
+    assert profiling["reconciles"] > 0
+    # the acceptance bar: the table names >= 5 distinct production
+    # stages, each row carrying the ns/reconcile rail
+    assert len(profiling["stages_seen"]) >= 5, profiling["stages_seen"]
+    for stage in ("informer-lookup", "serialize", "driver-mutate", "self-tax"):
+        assert stage in profiling["stages_seen"], profiling["stages_seen"]
+    for row in profiling["table"]:
+        for key in ("stage", "cpu_seconds", "wall_seconds", "hits",
+                    "cpu_ns_per_reconcile"):
+            assert key in row, f"table row missing {key!r}"
+        assert row["hits"] > 0
+    # exclusive-time ranking: hottest CPU first
+    cpu_column = [row["cpu_seconds"] for row in profiling["table"]]
+    assert cpu_column == sorted(cpu_column, reverse=True)
+    # per-AWS-op attribution split out of driver-mutate
+    assert any(
+        row["stage"].startswith("aws:") for row in profiling["table"]
+    ), [row["stage"] for row in profiling["table"]]
+    # the sampler ran alongside the profiled run
+    sampler = profiling["sampler"]
+    assert sampler["hz"] > 0 and sampler["samples"] > 0
+    assert sampler["top"], "sampler top table empty"
+    # the headline carries the profile at a glance
+    lines = [ln for ln in bench_run.stdout.splitlines() if ln.strip()]
+    headline = json.loads(lines[-1])
+    assert headline["profile"]["top_stage"] == profiling["table"][0]["stage"]
+    assert headline["profile"]["reconcile_cpu_us"] == profiling["reconcile_cpu_us"]
+    assert headline["profile"]["overhead_pct"] == profiling["overhead_pct"]
 
 
 def test_metrics_snapshot_scraped_per_phase(bench_run, detail_path):

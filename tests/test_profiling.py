@@ -1,0 +1,440 @@
+"""Unit tier for the continuous-profiling plane (ISSUE 14).
+
+Everything here runs on injected clocks and synthetic frames — zero
+real threads, zero wall-clock dependence:
+
+- the stage accountant's exclusive-time math (nesting, cpu-vs-wall
+  split, scope accumulation vs immediate flush, the disable switch);
+- the attribution surfaces (process aggregate, ranked table, the
+  exposition parser that completes the fleet merge);
+- the sampling profiler's folded-stack aggregation, top-N ranking and
+  timed capture, all against a synthetic ``frames_fn``;
+- the seam contract: under a sim-style ``clockseam.install`` the
+  accountant reads virtual CPU == wall and the sampler refuses to
+  start a thread — capped by a byte-identical-replay check with the
+  accountant armed vs disarmed;
+- every ``/debug/*`` endpoint of the manager health server, table
+  driven: status, content type, payload shape.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from agac_tpu import clockseam
+from agac_tpu.manager import make_health_server
+from agac_tpu.observability import metrics as obs_metrics
+from agac_tpu.observability import profile, stackprof
+from agac_tpu.observability.instruments import profile_instruments
+
+
+class ManualClock:
+    def __init__(self, now: float = 0.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture
+def clocks():
+    """A manually-advanced (cpu, wall) clock pair installed into the
+    seam, with the aggregate reset on both sides."""
+    cpu, wall = ManualClock(), ManualClock()
+    clockseam.install(monotonic=wall, thread_cpu=cpu)
+    profile.configure(stages=True)
+    profile.reset_aggregate()
+    yield cpu, wall
+    clockseam.reset()
+    profile.configure(stages=True)
+    profile.reset_aggregate()
+
+
+# ---------------------------------------------------------------------------
+# stage accountant: exclusive-time math
+# ---------------------------------------------------------------------------
+
+
+class TestStageAccountant:
+    def test_nested_stages_charge_exclusive_time(self, clocks):
+        cpu, wall = clocks
+        with profile.reconcile_scope("ga") as scope:
+            with profile.stage("driver-mutate"):
+                cpu.advance(0.003)
+                wall.advance(0.010)
+                with profile.api_stage("globalaccelerator", "create_accelerator"):
+                    cpu.advance(0.002)
+                    wall.advance(0.050)
+                cpu.advance(0.001)
+                wall.advance(0.005)
+        # the parent is charged only its own work: the child's
+        # inclusive time is subtracted on pop
+        assert scope.totals["driver-mutate"][0] == pytest.approx(0.004)
+        assert scope.totals["driver-mutate"][1] == pytest.approx(0.015)
+        child = scope.totals["aws:globalaccelerator.create_accelerator"]
+        assert child[0] == pytest.approx(0.002)
+        assert child[1] == pytest.approx(0.050)
+        # and the exclusive rows sum to the measured total
+        total_cpu = sum(entry[0] for entry in scope.totals.values())
+        assert total_cpu == pytest.approx(0.006)
+
+    def test_cpu_and_wall_are_independent_clocks(self, clocks):
+        cpu, wall = clocks
+        with profile.reconcile_scope("r53"):
+            with profile.stage("settle-park"):
+                wall.advance(1.0)  # parked: wall passes, no CPU burned
+        snap = profile.aggregate_snapshot()
+        entry = snap["stages"]["settle-park"]
+        assert entry["cpu_seconds"] == pytest.approx(0.0)
+        assert entry["wall_seconds"] == pytest.approx(1.0)
+
+    def test_scope_breakdown_reads_mid_flight(self, clocks):
+        cpu, wall = clocks
+        with profile.reconcile_scope("ga"):
+            with profile.stage("informer-lookup"):
+                cpu.advance(0.000004)
+                wall.advance(0.000004)
+            # the trace-annotation call site reads the breakdown while
+            # the scope is still open (stages closed so far)
+            assert profile.current_scope().breakdown_us() == {
+                "informer-lookup": 4
+            }
+        assert profile.current_scope() is profile._NULL_SCOPE
+        assert profile.current_scope().breakdown_us() == {}
+
+    def test_scope_flush_feeds_ratio_gauge_and_reconcile_counter(self, clocks):
+        cpu, wall = clocks
+        with profile.reconcile_scope("ga"):
+            with profile.stage("driver-mutate"):
+                cpu.advance(0.25)
+                wall.advance(1.0)
+        metrics = profile_instruments()
+        assert metrics.cpu_wall_ratio.labels(controller="ga").value() == pytest.approx(
+            0.25
+        )
+        assert metrics.reconciles.labels(controller="ga").value() >= 1.0
+
+    def test_stage_outside_scope_flushes_immediately(self, clocks):
+        cpu, wall = clocks
+        with profile.stage("gc-sweep"):
+            cpu.advance(0.5)
+            wall.advance(0.5)
+        snap = profile.aggregate_snapshot()
+        assert snap["stages"]["gc-sweep"]["hits"] == 1
+        # immediate flushes close no reconcile scope
+        assert snap["reconciles"] == 0
+        text = obs_metrics.registry().render()
+        assert 'agac_profile_stage_cpu_seconds_count{stage="gc-sweep",controller="manager"}' in text
+
+    def test_disabled_accountant_is_a_shared_noop(self, clocks):
+        cpu, wall = clocks
+        profile.configure(stages=False)
+        assert profile.stage("drift-tick") is profile._NULL_STAGE
+        assert profile.api_stage("route53", "x") is profile._NULL_STAGE
+        with profile.reconcile_scope("ga") as scope:
+            with profile.stage("driver-mutate"):
+                cpu.advance(1.0)
+        assert scope.breakdown_us() == {}
+        assert profile.aggregate_snapshot() == {"reconciles": 0, "stages": {}}
+
+    def test_exception_inside_stage_still_closes_the_frame(self, clocks):
+        cpu, wall = clocks
+        with pytest.raises(RuntimeError):
+            with profile.reconcile_scope("ga"):
+                with profile.stage("driver-mutate"):
+                    cpu.advance(0.010)
+                    raise RuntimeError("boom")
+        snap = profile.aggregate_snapshot()
+        assert snap["stages"]["driver-mutate"]["cpu_seconds"] == pytest.approx(0.010)
+        assert snap["reconciles"] == 1
+        # and the thread-local stack is clean for the next item
+        with profile.stage("gc-sweep"):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# attribution surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestAttribution:
+    def test_table_ranks_by_cpu_and_rates_per_reconcile(self, clocks):
+        cpu, wall = clocks
+        for _ in range(2):
+            with profile.reconcile_scope("ga"):
+                with profile.stage("serialize"):
+                    cpu.advance(0.001)
+                    wall.advance(0.001)
+                with profile.stage("driver-mutate"):
+                    cpu.advance(0.004)
+                    wall.advance(0.004)
+        table = profile.attribution_table()
+        assert [row["stage"] for row in table] == ["driver-mutate", "serialize"]
+        assert table[0]["hits"] == 2
+        # 0.008 s over 2 reconciles -> 4 ms/reconcile
+        assert table[0]["cpu_ns_per_reconcile"] == 4_000_000
+        assert profile.attribution_table(top=1) == table[:1]
+
+    def test_exposition_parser_merges_controllers(self):
+        text = "\n".join(
+            [
+                "# HELP agac_profile_stage_cpu_seconds x",
+                'agac_profile_stage_cpu_seconds_bucket{stage="driver-mutate",controller="ga",le="+Inf"} 10',
+                'agac_profile_stage_cpu_seconds_sum{stage="driver-mutate",controller="ga"} 0.5',
+                'agac_profile_stage_cpu_seconds_count{stage="driver-mutate",controller="ga"} 10',
+                'agac_profile_stage_cpu_seconds_sum{stage="driver-mutate",controller="r53"} 0.25',
+                'agac_profile_stage_cpu_seconds_count{stage="driver-mutate",controller="r53"} 5',
+                'agac_profile_stage_wall_seconds_sum{stage="driver-mutate",controller="ga"} 2.0',
+                'agac_profile_stage_cpu_seconds_sum{stage="serialize",controller="ga"} 0.1',
+                'agac_profile_stage_cpu_seconds_count{stage="serialize",controller="ga"} 10',
+            ]
+        )
+        rows = profile.attribution_from_exposition(text)
+        assert [row["stage"] for row in rows] == ["driver-mutate", "serialize"]
+        top = rows[0]
+        # summed across the ga + r53 shard replicas: the fleet merge
+        assert top["cpu_seconds"] == pytest.approx(0.75)
+        assert top["wall_seconds"] == pytest.approx(2.0)
+        assert top["hits"] == 15
+        assert top["cpu_ns_per_hit"] == 50_000_000
+
+    def test_real_render_round_trips_through_the_parser(self, clocks):
+        cpu, wall = clocks
+        registry = obs_metrics.MetricsRegistry()
+        metrics = profile_instruments(registry)
+        metrics.stage_cpu.labels(stage="drift-tick", controller="manager").observe(0.125)
+        metrics.stage_wall.labels(stage="drift-tick", controller="manager").observe(0.25)
+        rows = profile.attribution_from_exposition(registry.render())
+        assert rows == [
+            {
+                "stage": "drift-tick",
+                "cpu_seconds": 0.125,
+                "wall_seconds": 0.25,
+                "hits": 1,
+                "cpu_ns_per_hit": 125_000_000,
+            }
+        ]
+
+
+# ---------------------------------------------------------------------------
+# sampling profiler: synthetic frames
+# ---------------------------------------------------------------------------
+
+
+class FakeCode:
+    def __init__(self, name: str, filename: str = "app.py"):
+        self.co_name = name
+        self.co_filename = filename
+
+
+class FakeFrame:
+    """Leaf-first construction, walked via f_back like a real frame."""
+
+    def __init__(self, name: str, lineno: int, back: "FakeFrame | None" = None):
+        self.f_code = FakeCode(name)
+        self.f_lineno = lineno
+        self.f_back = back
+
+
+def chain(*names: str) -> FakeFrame:
+    """chain("root", "mid", "leaf") -> the LEAF frame of that stack."""
+    frame = None
+    for i, name in enumerate(names):
+        frame = FakeFrame(name, lineno=i + 1, back=frame)
+    return frame
+
+
+class TestFoldedStacks:
+    def test_folded_lines_are_root_first_and_deterministic(self):
+        stacks = stackprof.FoldedStacks()
+        for _ in range(3):
+            stacks.add_frame(chain("main", "reconcile", "mutate"))
+        stacks.add_frame(chain("main", "drift"))
+        lines = stacks.folded().splitlines()
+        assert lines[0].startswith("main (app.py:1);reconcile (app.py:2);mutate (app.py:3) 3")
+        assert lines[1].startswith("main (app.py:1);drift (app.py:2) 1")
+        assert stacks.samples == 4
+
+    def test_top_separates_self_from_cumulative(self):
+        stacks = stackprof.FoldedStacks()
+        for _ in range(3):
+            stacks.add_frame(chain("main", "reconcile", "mutate"))
+        stacks.add_frame(chain("main", "reconcile"))
+        top = stacks.top(3)
+        assert top[0]["func"].startswith("mutate") and top[0]["self"] == 3
+        # reconcile: on top of 1 stack, present in all 4
+        reconcile = next(r for r in top if r["func"].startswith("reconcile"))
+        assert reconcile["self"] == 1 and reconcile["cum"] == 4
+        assert top[0]["self_pct"] == 75.0
+
+    def test_merge_adds_counts(self):
+        a, b = stackprof.FoldedStacks(), stackprof.FoldedStacks()
+        a.add_frame(chain("main", "x"))
+        b.add_frame(chain("main", "x"))
+        b.add_frame(chain("main", "y"))
+        a.merge(b)
+        assert a.samples == 3
+        assert "main (app.py:1);x (app.py:2) 2" in a.folded()
+
+    def test_max_depth_bounds_the_walk(self):
+        stacks = stackprof.FoldedStacks()
+        stacks.add_frame(chain(*[f"f{i}" for i in range(10)]), max_depth=3)
+        (key_line,) = stacks.folded().splitlines()
+        # the walk keeps the three frames nearest the leaf
+        assert key_line.count(";") == 2 and "f9" in key_line
+
+
+class TestStackProfilerCapture:
+    def test_capture_is_deterministic_on_injected_seams(self):
+        clock = ManualClock()
+        frames = {101: chain("main", "reconcile", "mutate")}
+        profiler = stackprof.StackProfiler(
+            hz=4.0,
+            frames_fn=lambda: frames,
+            clock=clock,
+            sleep=clock.advance,
+        )
+        result = profiler.capture(seconds=1.0)
+        # samples at t=0.0 .. 1.0 inclusive at 0.25 s intervals (exactly
+        # representable, so the count is float-proof)
+        assert result["samples"] == 5
+        assert result["hz"] == 4.0
+        assert result["folded"].endswith(" 5")
+        assert result["top"][0]["func"].startswith("mutate")
+
+    def test_capture_clamps_seconds(self):
+        clock = ManualClock()
+        profiler = stackprof.StackProfiler(
+            hz=1.0, frames_fn=dict, clock=clock, sleep=clock.advance
+        )
+        assert profiler.capture(seconds=3600)["seconds"] == 60.0
+        assert profiler.capture(seconds=-5)["seconds"] == 0.0
+
+    def test_sampler_thread_excludes_itself(self):
+        me = threading.get_ident()
+        frames = {me: chain("sampler"), 7: chain("worker")}
+        profiler = stackprof.StackProfiler(frames_fn=lambda: frames)
+        into = stackprof.FoldedStacks()
+        profiler.sample_once(into, skip_threads=frozenset({me}))
+        assert into.samples == 1 and "worker" in into.folded()
+
+    def test_start_refuses_without_threads(self):
+        clockseam.install(monotonic=ManualClock(), threads=False)
+        try:
+            profiler = stackprof.StackProfiler(frames_fn=dict)
+            assert profiler.start(threading.Event()) is None
+        finally:
+            clockseam.reset()
+
+
+# ---------------------------------------------------------------------------
+# the seam contract under simulation
+# ---------------------------------------------------------------------------
+
+
+class TestSimDeterminism:
+    def test_sim_install_routes_thread_cpu_to_virtual_monotonic(self):
+        wall = ManualClock(100.0)
+        clockseam.install(monotonic=wall)
+        try:
+            assert clockseam.thread_cpu() == 100.0
+            wall.advance(5.0)
+            assert clockseam.thread_cpu() == clockseam.monotonic() == 105.0
+        finally:
+            clockseam.reset()
+
+    def test_replay_hash_is_stable_with_accountant_armed(self):
+        """The profiling plane must not perturb the deterministic sim:
+        same seed, accountant on — byte-identical trace; accountant
+        off — STILL the same trace (pure clock reads, no scheduling)."""
+        from agac_tpu.sim import fuzz
+
+        profile.configure(stages=True)
+        armed_a = fuzz.run_scenario(3, profile="mini")
+        armed_b = fuzz.run_scenario(3, profile="mini")
+        assert armed_a.ok, armed_a.violations
+        assert armed_a.trace_hash == armed_b.trace_hash
+        profile.configure(stages=False)
+        try:
+            disarmed = fuzz.run_scenario(3, profile="mini")
+        finally:
+            profile.configure(stages=True)
+        assert disarmed.trace_hash == armed_a.trace_hash
+
+
+# ---------------------------------------------------------------------------
+# /debug/* endpoints, table-driven (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _get(base: str, path: str):
+    try:
+        with urllib.request.urlopen(base + path, timeout=5) as response:
+            return response.status, response.headers.get("Content-Type"), response.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.headers.get("Content-Type"), err.read()
+
+
+# (path, expected status, content-type prefix, required JSON keys —
+# None for non-JSON bodies)
+DEBUG_ENDPOINTS = [
+    ("/healthz", 200, "application/json", {"workers", "stuck", "gc", "sharding", "slo", "autoscaler"}),
+    ("/readyz", 200, "application/json", {"open_circuits", "services"}),
+    ("/metrics", 200, "text/plain", None),
+    ("/metrics/fleet", 200, "text/plain", None),
+    ("/slo", 200, "application/json", set()),
+    ("/debug/flightrecorder", 200, "application/json", {"capacity", "recorded_total", "entries"}),
+    ("/debug/queues", 200, "application/json", set()),
+    ("/debug/autoscaler", 200, "application/json", {"status", "decisions"}),
+    ("/debug/profile?seconds=0", 200, "application/json", {"hz", "seconds", "samples", "folded", "top", "stages"}),
+    ("/debug/profile?seconds=0&format=folded", 200, "text/plain", None),
+    ("/debug/profile?seconds=bogus", 400, "application/json", {"error"}),
+    ("/debug/nonexistent", 404, None, None),
+]
+
+
+class TestDebugEndpoints:
+    @pytest.fixture(scope="class")
+    def base(self):
+        server = make_health_server(0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            yield f"http://127.0.0.1:{server.server_address[1]}"
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    @pytest.mark.parametrize(
+        "path,status,ctype,keys",
+        DEBUG_ENDPOINTS,
+        ids=[row[0] for row in DEBUG_ENDPOINTS],
+    )
+    def test_endpoint_contract(self, base, path, status, ctype, keys):
+        got_status, got_ctype, body = _get(base, path)
+        assert got_status == status
+        if ctype is not None:
+            assert (got_ctype or "").startswith(ctype), got_ctype
+        if keys is not None:
+            payload = json.loads(body)
+            assert isinstance(payload, dict)
+            assert keys <= set(payload), sorted(payload)
+
+    def test_profile_capture_rides_the_stage_table(self, base):
+        profile.reset_aggregate()
+        with profile.stage("drift-tick"):
+            pass
+        _, _, body = _get(base, "/debug/profile?seconds=0")
+        payload = json.loads(body)
+        assert any(row["stage"] == "drift-tick" for row in payload["stages"])
+        # a zero-second capture still walks the live threads once
+        assert payload["samples"] >= 1
+        assert "serve_forever" in payload["folded"] or payload["top"]
