@@ -83,6 +83,16 @@ stack — the classes ruff's pyflakes-tier cannot express:
   never account for.  Dynamic per-AWS-op stages flow through
   ``profile.api_stage(service, op)`` instead.
 
+- ``unexplained-requeue`` — requeue/park/skip decisions in
+  ``reconcile/`` and ``controllers/`` (``add_rate_limited`` /
+  ``add_after`` / ``park`` calls, and ``Result`` values carrying
+  ``requeue``/``requeue_after``/``skip``) must attach a literal reason
+  code from the explain catalog in ``observability/explain.py``
+  (ISSUE 15): the explain plane classifies a blocked object from the
+  structured reason recorded where its fate was decided, so an
+  unexplained (or computed) movement is a key ``/debug/explain`` can
+  only shrug at — exactly the ``unknown`` verdict the catalog forbids.
+
 Suppression: append ``# agac-lint: ignore[rule-id] -- justification``
 to the offending line.  The justification is mandatory.
 """
@@ -1093,6 +1103,134 @@ def check_unattributed_stage(tree: ast.Module, ctx: LintContext) -> Iterator[Vio
                 "from the catalog in observability/profile.py — add it to "
                 "STAGES (with a description) so the attribution table, "
                 "metrics docs and bench rails account for it",
+            )
+
+
+# ---------------------------------------------------------------------------
+# unexplained-requeue
+# ---------------------------------------------------------------------------
+
+# literal copy of the explain plane's call-site reason catalog
+# (observability/explain.py REASON_CODES) — the linter never imports
+# the package it lints (the RAW_API_OPS / _STAGE_NAMES precedent), and
+# a sync test pins the two sets equal
+_REQUEUE_REASON_CODES = frozenset({
+    "in-flight",
+    "backoff",
+    "circuit-open",
+    "quota-paced",
+    "parked-settle",
+    "shed",
+    "not-owner",
+})
+
+# the item movements that must carry a structured reason: the same
+# fate-changing moves the journey-stamp rule watches, plus the Result
+# kwargs that *cause* them one frame up the loop
+_EXPLAIN_MOVES = frozenset({"add_rate_limited", "add_after", "park"})
+_RESULT_FATE_KWARGS = frozenset({"requeue", "requeue_after", "skip"})
+# the queue implementation re-adds items internally (mechanism, not a
+# decision) and result.py is the dataclass itself
+_EXPLAIN_EXEMPT_FILES = frozenset({"workqueue.py", "result.py", "__init__.py"})
+
+
+def _in_explain_scope(ctx: LintContext) -> bool:
+    return (
+        ("reconcile" in ctx.path.parts or "controllers" in ctx.path.parts)
+        and ctx.path.name not in _EXPLAIN_EXEMPT_FILES
+    )
+
+
+def _explained_reason(node: ast.expr) -> Optional[str]:
+    """None when the reason expression is acceptable; otherwise the
+    complaint.  Acceptable: a literal from the catalog, or a
+    ``<something>.reason`` attribute (a Result's structured reason
+    flowing through the loop unchanged)."""
+    if isinstance(node, ast.Attribute) and node.attr == "reason":
+        return None
+    if not _literal_str(node):
+        return (
+            "computed reason string — the explain verdict catalog is "
+            "closed, so reasons must be literals from "
+            "observability/explain.py REASON_CODES (or a Result's "
+            "``.reason`` passed through)"
+        )
+    if node.value not in _REQUEUE_REASON_CODES:
+        return (
+            f"reason {node.value!r} is not in the explain call-site "
+            "catalog (observability/explain.py REASON_CODES) — an "
+            "uncataloged reason is a verdict /debug/explain can never "
+            "map, i.e. exactly the 'unknown' the plane forbids"
+        )
+    return None
+
+
+@rule(
+    "unexplained-requeue",
+    "requeue/park/skip sites in reconcile/ and controllers/ must carry a "
+    "literal reason code from the explain catalog — an unexplained movement "
+    "is a blocked object /debug/explain cannot diagnose",
+)
+def check_unexplained_requeue(tree: ast.Module, ctx: LintContext) -> Iterator[Violation]:
+    """The explain plane (ISSUE 15) classifies a blocked object from
+    the structured reason attached where its fate was decided — at the
+    ``add_rate_limited``/``add_after``/``park`` call, or on the
+    ``Result`` that requests the requeue/skip.  A site that omits the
+    reason (or computes it) degrades the verdict to a bare ``backoff``
+    guess, which is precisely the diagnostic gap the plane exists to
+    close."""
+    if not _in_explain_scope(ctx):
+        return
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _EXPLAIN_MOVES:
+            reason_kw = next(
+                (k.value for k in node.keywords if k.arg == "reason"), None
+            )
+            if reason_kw is None:
+                yield Violation(
+                    "unexplained-requeue",
+                    str(ctx.path),
+                    node.lineno,
+                    f".{func.attr}(...) without a reason= code — attach a "
+                    "literal from observability/explain.py REASON_CODES so "
+                    "/debug/explain can say why this key is waiting",
+                )
+                continue
+            complaint = _explained_reason(reason_kw)
+            if complaint is not None:
+                yield Violation(
+                    "unexplained-requeue", str(ctx.path), node.lineno,
+                    f".{func.attr}(...): {complaint}",
+                )
+            continue
+        # Result(requeue=..., requeue_after=..., skip=...) one frame up
+        if not (isinstance(func, ast.Name) and func.id == "Result"):
+            continue
+        kwargs = {k.arg for k in node.keywords}
+        if not kwargs & _RESULT_FATE_KWARGS:
+            continue
+        reason_kw = next(
+            (k.value for k in node.keywords if k.arg == "reason"), None
+        )
+        if reason_kw is None:
+            fate = ", ".join(sorted(kwargs & _RESULT_FATE_KWARGS))
+            yield Violation(
+                "unexplained-requeue",
+                str(ctx.path),
+                node.lineno,
+                f"Result({fate}=...) without a reason= code — the loop "
+                "forwards Result.reason to the workqueue, so an empty one "
+                "leaves /debug/explain guessing 'backoff'",
+            )
+            continue
+        complaint = _explained_reason(reason_kw)
+        if complaint is not None:
+            yield Violation(
+                "unexplained-requeue", str(ctx.path), node.lineno,
+                f"Result(...): {complaint}",
             )
 
 

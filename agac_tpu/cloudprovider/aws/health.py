@@ -107,7 +107,11 @@ class DeadlineExceeded(AWSAPIError):
     the next attempt gets a fresh deadline — the point is to free the
     worker, not to abandon the object."""
 
-    def __init__(self, message: str = ""):
+    def __init__(self, message: str = "", paced: bool = False):
+        # paced=True: the deadline was consumed by ADAPTIVE PACING
+        # (AIMD quota backpressure), not by a slow call — the explain
+        # plane classifies that requeue as quota-paced, not backoff
+        self.paced = paced
         super().__init__("DeadlineExceeded", message)
 
 
@@ -496,7 +500,8 @@ class ServiceHealth:
         if remaining is not None and remaining <= delay:
             raise DeadlineExceeded(
                 f"{self.name}: {delay:.2f}s of adaptive pacing exceeds the "
-                f"{remaining:.2f}s left on the reconcile deadline"
+                f"{remaining:.2f}s left on the reconcile deadline",
+                paced=True,
             )
         self._sleep(delay)
 

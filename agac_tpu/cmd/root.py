@@ -349,6 +349,32 @@ def build_parser() -> argparse.ArgumentParser:
         "moved keyspace fraction) without writing the ring lease.",
     )
 
+    explain = sub.add_parser(
+        "explain",
+        help="Explain why an object has not converged (ISSUE 15): query "
+        "every replica's /debug/explain, let the owning shard answer, "
+        "and merge — non-owners report not-owner with their ring epoch.",
+    )
+    explain.add_argument(
+        "key",
+        help="Object key as namespace/name (e.g. default/my-service).",
+    )
+    explain.add_argument(
+        "--controller", default="",
+        help="Restrict the verdict to one controller worker (e.g. "
+        "'service'); default merges across all controllers.",
+    )
+    explain.add_argument(
+        "--fleet-peers", default="127.0.0.1:8080",
+        help="Comma-separated host:port health endpoints of every "
+        "replica (same value as the controller's --fleet-peers). A "
+        "single peer queries just that replica.",
+    )
+    explain.add_argument(
+        "--timeout", type=float, default=3.0,
+        help="Per-peer HTTP timeout in seconds.",
+    )
+
     sub.add_parser("version", help="Print the version number")
 
     manifests = sub.add_parser(
@@ -711,6 +737,51 @@ def run_resize_shards(args) -> int:
     return 0
 
 
+def run_explain(args) -> int:
+    """Query /debug/explain across the fleet and print the merged verdict.
+
+    Every peer is asked; the owning shard's answer wins (see
+    observability.explain.merge_fleet_explains). Peers that cannot be
+    reached are reported in the ``peers`` map rather than dropped, so a
+    partial fleet still yields the most-blocking view of what answered.
+    """
+    import json
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from ..observability import explain as obs_explain
+
+    peers = [p.strip() for p in args.fleet_peers.split(",") if p.strip()]
+    if not peers:
+        print("no --fleet-peers given", file=sys.stderr)
+        return 2
+    params = {"key": args.key}
+    if args.controller:
+        params["controller"] = args.controller
+    query = urllib.parse.urlencode(params)
+
+    answers = {}
+    for peer in peers:
+        url = peer if peer.startswith("http") else f"http://{peer}"
+        url = url.rstrip("/") + "/debug/explain?" + query
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                answers[peer] = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as err:
+            # 4xx still carries the JSON error contract; surface it
+            try:
+                answers[peer] = json.loads(err.read().decode("utf-8"))
+            except Exception:
+                answers[peer] = {"error": f"HTTP {err.code}"}
+        except Exception as err:
+            answers[peer] = {"error": str(err)}
+
+    merged = obs_explain.merge_fleet_explains(answers)
+    print(json.dumps(merged, indent=2, sort_keys=True))
+    return 0 if merged.get("owner") else 1
+
+
 def run_webhook(args) -> int:
     from ..webhook import Server
 
@@ -752,6 +823,8 @@ def main(argv=None) -> int:
         return run_controller(args)
     if args.command == "resize-shards":
         return run_resize_shards(args)
+    if args.command == "explain":
+        return run_explain(args)
     if args.command == "webhook":
         return run_webhook(args)
     if args.command == "version":

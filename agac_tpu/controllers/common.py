@@ -128,6 +128,7 @@ def with_circuit_backoff(process):
             return Result(
                 requeue=True,
                 requeue_after=max(err.retry_after, CIRCUIT_RETRY_FLOOR),
+                reason="circuit-open",
             )
 
     wrapped.__name__ = getattr(process, "__name__", "process")
@@ -152,7 +153,7 @@ def with_shard_guard(shard_filter, process):
             key = arg if isinstance(arg, str) else meta_namespace_key(arg)
             owned = shard_filter.owns_key(key)
         if not owned:
-            return Result(skip=True)
+            return Result(skip=True, reason="not-owner")
         return process(arg)
 
     guarded.__name__ = getattr(process, "__name__", "process")
@@ -169,6 +170,7 @@ def run_workers(
     process_create_or_update=None,
     on_sync_result=None,
     reconcile_deadline: float | None = None,
+    managed=None,
 ) -> list[threading.Thread]:
     """Launch ``workers`` worker threads looping
     ``process_next_work_item`` until queue shutdown (the analog of
@@ -182,7 +184,12 @@ def run_workers(
     Both process funcs are wrapped circuit-aware (see
     ``with_circuit_backoff``), and ``reconcile_deadline`` arms the
     per-item deadline the driver's poll loops and backend retries
-    consult (health plane; None/0 disables)."""
+    consult (health plane; None/0 disables).
+
+    ``managed`` (a predicate over the cached object) is part of the
+    worker-spec shape for the explain plane's not-managed verdict; the
+    worker loop itself never consults it."""
+    del managed
     process_delete = with_circuit_backoff(process_delete)
     process_create_or_update = with_circuit_backoff(process_create_or_update)
 

@@ -125,7 +125,7 @@ class EndpointGroupBindingController:
         # the journey label is the WORKER name (what the reconcile
         # loop closes under), not the queue's kind name
         stamp_journey_enqueued(CONTROLLER_AGENT_NAME, obj)
-        self.workqueue.add_rate_limited(key)
+        self.workqueue.add_rate_limited(key, reason="in-flight")
 
     def _resync_enqueue(self, obj, trigger: str) -> None:
         """Drift/handoff re-enqueue: journey-stamped, then the plain
@@ -172,6 +172,9 @@ class EndpointGroupBindingController:
                     self.recorder, self._key_to_binding
                 ),
                 reconcile_deadline=self._reconcile_deadline,
+                # explain plane (ISSUE 15): every EndpointGroupBinding
+                # is managed (no annotation gate)
+                managed=None,
             ),
         ]
 
@@ -257,7 +260,7 @@ class EndpointGroupBindingController:
         obj.status.endpoint_ids = []
         obj.status.observed_generation = obj.metadata.generation
         self._client.update_status(KIND, obj)
-        return Result(requeue=True, requeue_after=1.0)
+        return Result(requeue=True, requeue_after=1.0, reason="in-flight")
 
     def _reconcile_update(self, obj: EndpointGroupBinding, cloud) -> Result:
         hostnames = self._load_balancer_hostnames(obj)
@@ -360,7 +363,10 @@ class EndpointGroupBindingController:
                 obj.spec.weight,
             )
             if retry_after > 0:
-                return Result(requeue=True, requeue_after=retry_after)
+                # the add is settling on the AWS side — forward
+                # progress, not an error backoff
+                return Result(requeue=True, requeue_after=retry_after,
+                              reason="in-flight")
             if added_id is not None and added_id not in results:
                 # drift repair re-adds ids that are still in status —
                 # appending unconditionally would duplicate them

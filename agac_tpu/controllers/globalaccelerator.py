@@ -234,7 +234,7 @@ class GlobalAcceleratorController:
             return  # another shard's replica reconciles this key
         if journey:
             stamp_journey_enqueued(queue.name, obj)
-        queue.add_rate_limited(key)
+        queue.add_rate_limited(key, reason="in-flight")
 
     def _resync_enqueue(
         self, queue: RateLimitingQueue, obj, trigger: str,
@@ -299,6 +299,9 @@ class GlobalAcceleratorController:
                     self.recorder, self._key_to_service
                 ),
                 reconcile_deadline=self._reconcile_deadline,
+                # explain plane (ISSUE 15): is this cached object one
+                # the controller manages at all?
+                managed=is_managed_service,
             ),
             dict(
                 name=f"{CONTROLLER_AGENT_NAME}-ingress",
@@ -314,6 +317,7 @@ class GlobalAcceleratorController:
                     self.recorder, self._key_to_ingress
                 ),
                 reconcile_deadline=self._reconcile_deadline,
+                managed=is_managed_ingress,
             ),
         ]
 
@@ -448,5 +452,9 @@ class GlobalAcceleratorController:
                     arn,
                 )
             if retry_after > 0:
-                return Result(requeue=True, requeue_after=retry_after)
+                # the ensure chain is mid-flight on the AWS side (a
+                # staged create or a settle hint): the wait is forward
+                # progress, not an error backoff
+                return Result(requeue=True, requeue_after=retry_after,
+                              reason="in-flight")
         return Result()

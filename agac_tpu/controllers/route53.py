@@ -199,7 +199,7 @@ class Route53Controller:
             return  # another shard's replica reconciles this key
         if journey:
             stamp_journey_enqueued(queue.name, obj)
-        queue.add_rate_limited(key)
+        queue.add_rate_limited(key, reason="in-flight")
 
     def _resync_enqueue(
         self, queue: RateLimitingQueue, obj, trigger: str,
@@ -273,6 +273,8 @@ class Route53Controller:
                     self.recorder, self._key_to_service
                 ),
                 reconcile_deadline=self._reconcile_deadline,
+                # explain plane (ISSUE 15): the not-managed predicate
+                managed=is_hostname_managed_service,
             ),
             dict(
                 name=f"{CONTROLLER_AGENT_NAME}-ingress",
@@ -288,6 +290,7 @@ class Route53Controller:
                     self.recorder, self._key_to_ingress
                 ),
                 reconcile_deadline=self._reconcile_deadline,
+                managed=is_hostname_managed_ingress,
             ),
         ]
 
@@ -422,7 +425,10 @@ class Route53Controller:
                     obj, lb_ingress, hostnames, self.cluster_name
                 )
             if retry_after > 0:
-                return Result(requeue=True, requeue_after=retry_after)
+                # waiting on the GlobalAccelerator chain (or a change
+                # batch) to converge — forward progress, not backoff
+                return Result(requeue=True, requeue_after=retry_after,
+                              reason="in-flight")
             if created:
                 self.recorder.eventf(
                     obj,

@@ -48,6 +48,7 @@ from .observability import profile as obs_profile
 from .observability import recorder as obs_recorder
 from .observability import stackprof as obs_stackprof
 from .observability import slo as obs_slo
+from .observability import explain as obs_explain
 from .controllers import (
     EndpointGroupBindingConfig,
     EndpointGroupBindingController,
@@ -176,6 +177,8 @@ class Manager:
         # scheduler round explicitly (tests/bench, the drift_tick
         # pattern).
         self.settle_table = None
+        # the explain plane (ISSUE 15), built by build()
+        self.explain_engine: Optional[obs_explain.ExplainEngine] = None
 
     def build(
         self,
@@ -225,6 +228,30 @@ class Manager:
                 client, informer_factory, config, cloud_factory,
                 self.shard_filter,
             )
+        # the explain plane (ISSUE 15): one engine per manager, wired
+        # to every plane the blocked-on classification consults; the
+        # settle table is late-bound (run()/the sim harness attach it
+        # after build), hence the lambda
+        self.explain_engine = obs_explain.ExplainEngine(
+            identity=(
+                self.shard_membership.identity
+                if self.shard_membership is not None
+                else (config.sharding.identity or "")
+            ),
+            settle_table=lambda: self.settle_table,
+            health=self._health,
+            shard_filter=lambda: self.shard_filter,
+            resize_status=self._resize_status,
+            informers_synced=self._informers_synced,
+            slo_shedding=self._slo_shedding,
+        )
+        self.explain_engine.bind_metrics(self.metrics_registry)
+        for controller in self.controllers.values():
+            for spec in controller.worker_specs():
+                self.explain_engine.register_worker(
+                    spec["name"], spec["queue"], spec["key_to_obj"],
+                    managed=spec.get("managed"),
+                )
         gc_config = config.garbage_collector
         if gc_config.interval > 0 and cloud_factory is not None:
             # the sweeper shares the controllers' informer caches (its
@@ -251,6 +278,12 @@ class Manager:
         with ``block=True`` (the reference's ``wg.Wait()``) returns only
         after ``stop`` fires and all controller threads exit."""
         informer_factory = self.build(client, config, cloud_factory)
+        # the threaded (production) lifecycle owns the process: its
+        # engine becomes the global one the reconcile loop's recorder
+        # stamps and the default /debug/explain lookup resolve.  The
+        # sim harness calls build() directly and reads each replica's
+        # own engine instead.
+        obs_explain.install(self.explain_engine)
         threads = []
         for name, controller in self.controllers.items():
             klog.infof("Starting %s", name)
@@ -396,6 +429,21 @@ class Manager:
             lease_prefix=membership.config.lease_prefix,
             vnodes=membership.config.vnodes,
         )
+
+    def _resize_status(self) -> dict:
+        """The live resize view the explain engine stamps verdicts
+        with ({} in single-shard mode)."""
+        if self.shard_membership is None:
+            return {}
+        return self.shard_membership.resize_status()
+
+    @staticmethod
+    def _slo_shedding() -> bool:
+        """True while the SLO engine is actively shedding deferrable
+        load — read from the attribute, NOT ``should_shed`` (that gate
+        counts a shed action; an explain lookup must not)."""
+        slo_engine = obs_slo.engine()
+        return bool(slo_engine is not None and slo_engine.shedding)
 
     def _informers_synced(self) -> bool:
         if self.informer_factory is None:
@@ -684,38 +732,21 @@ class _HealthHandler(BaseHTTPRequestHandler):
         klog.v(4).infof("health http: " + fmt, *args)
 
     def do_GET(self):
-        if self.path == "/healthz":
-            self._healthz()
+        # every endpoint dispatches on the bare path through one route
+        # table (ISSUE 15 satellite) with one shared query parser and a
+        # uniform JSON error contract: unknown path → 404 JSON naming
+        # the known endpoints, bad query → 400 JSON with "error"
+        path, _, raw_query = self.path.partition("?")
+        handler = self._ROUTES.get(path)
+        if handler is None:
+            self._respond(404, {
+                "error": f"no such endpoint: {path}",
+                "endpoints": sorted(self._ROUTES),
+            })
             return
-        if self.path == "/readyz":
-            self._readyz()
-            return
-        if self.path == "/metrics":
-            self._metrics()
-            return
-        if self.path == "/metrics/fleet":
-            self._fleet_metrics()
-            return
-        if self.path == "/slo":
-            self._slo()
-            return
-        if self.path == "/debug/flightrecorder":
-            self._flightrecorder()
-            return
-        if self.path == "/debug/queues":
-            self._respond(200, self.server.queue_status())
-            return
-        if self.path == "/debug/autoscaler":
-            self._autoscaler()
-            return
-        # /debug/profile carries a query string (?seconds=N&format=...),
-        # so it dispatches on the bare path, not an exact match
-        if self.path.split("?", 1)[0] == "/debug/profile":
-            self._profile()
-            return
-        self.send_error(404)
+        handler(self, _parse_query(raw_query))
 
-    def _healthz(self):
+    def _healthz(self, query=None):
         """Process liveness: 200 unless a worker is stuck past the
         threshold (a wedged worker pool deserves a kubelet restart —
         state is all external, restart-resume is proven by the
@@ -745,7 +776,7 @@ class _HealthHandler(BaseHTTPRequestHandler):
         }
         self._respond(500 if stuck else 200, body)
 
-    def _readyz(self):
+    def _readyz(self, query=None):
         """Readiness: 503 while any API circuit is open — the pod is
         alive but degraded, and deployment probes/rollouts should see
         that without scraping logs."""
@@ -758,7 +789,7 @@ class _HealthHandler(BaseHTTPRequestHandler):
         }
         self._respond(503 if open_services else 200, body)
 
-    def _metrics(self):
+    def _metrics(self, query=None):
         """Prometheus text exposition of the wired registry (ISSUE 5):
         the scrape endpoint operators point their Prometheus at."""
         payload = self.server.metrics_registry.render().encode()
@@ -768,14 +799,14 @@ class _HealthHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
-    def _slo(self):
+    def _slo(self, query=None):
         """The convergence SLO plane in full (ISSUE 9): declared
         objectives with burn rates and quantile estimates, shed state,
         and the slowest unconverged journeys (each id greps straight
         into /debug/flightrecorder)."""
         self._respond(200, self.server.slo_status())
 
-    def _fleet_metrics(self):
+    def _fleet_metrics(self, query=None):
         """The fleet-merged exposition (ISSUE 9): this replica's
         registry plus every configured peer's /metrics — counters and
         journey histograms summed, gauges labeled by shard.  A peer
@@ -788,7 +819,7 @@ class _HealthHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
-    def _flightrecorder(self):
+    def _flightrecorder(self, query=None):
         """The flight recorder's ring buffer, oldest → newest — the
         live post-mortem of the last few hundred reconcile outcomes."""
         recorder = self.server.flight_recorder
@@ -801,7 +832,10 @@ class _HealthHandler(BaseHTTPRequestHandler):
             },
         )
 
-    def _autoscaler(self):
+    def _queues(self, query=None):
+        self._respond(200, self.server.queue_status())
+
+    def _autoscaler(self, query=None):
         """The autoscaler's bounded decision history, oldest → newest,
         each entry carrying the full evidence snapshot the policy saw
         — suppressed decisions included (a quiet autoscaler should be
@@ -814,7 +848,7 @@ class _HealthHandler(BaseHTTPRequestHandler):
             },
         )
 
-    def _profile(self):
+    def _profile(self, query):
         """On-demand sampling-profiler capture (ISSUE 14):
         ``?seconds=N`` samples the live process for N seconds (bounded
         by the profiler) and returns the folded stacks plus the ranked
@@ -823,17 +857,14 @@ class _HealthHandler(BaseHTTPRequestHandler):
         attribution table rides along so one curl answers both "where
         is wall time going right now" and "where has CPU gone since
         start"."""
-        query = urllib.parse.parse_qs(
-            urllib.parse.urlparse(self.path).query
-        )
         try:
-            seconds = float(query.get("seconds", ["1"])[0])
-            hz = float(query.get("hz", ["0"])[0]) or None
+            seconds = float(query.get("seconds", "1"))
+            hz = float(query.get("hz", "0")) or None
         except ValueError:
             self._respond(400, {"error": "seconds/hz must be numbers"})
             return
         capture = self.server.profile_capture(seconds, hz)
-        if query.get("format", [""])[0] == "folded":
+        if query.get("format", "") == "folded":
             payload = (capture["folded"] + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; charset=utf-8")
@@ -844,6 +875,31 @@ class _HealthHandler(BaseHTTPRequestHandler):
         capture["stages"] = obs_profile.attribution_table()
         self._respond(200, capture)
 
+    def _explain(self, query):
+        """The explain plane's single-key probe (ISSUE 15):
+        ``?key=ns/name[&controller=worker-label]`` returns the
+        blocked-on verdict + causal timeline per controller — every
+        lookup O(1) per key, no fleet enumeration.  Unknown controller
+        → 404; missing/malformed key → 400."""
+        key = query.get("key", "")
+        if not key:
+            self._respond(400, {"error": "missing required query param: key"})
+            return
+        if "/" not in key:
+            self._respond(400, {
+                "error": f"key must be namespace/name, got {key!r}"
+            })
+            return
+        controller = query.get("controller") or None
+        try:
+            answer = self.server.explain_lookup(key, controller)
+        except KeyError:
+            self._respond(404, {
+                "error": f"no such controller: {controller!r}",
+            })
+            return
+        self._respond(200, answer)
+
     def _respond(self, code: int, body: dict):
         payload = json.dumps(body).encode()
         self.send_response(code)
@@ -851,6 +907,32 @@ class _HealthHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
+
+    # bare path → handler(self, query): the single dispatch surface —
+    # a new endpoint is one row here (plus its handler), and the 404
+    # body enumerates exactly this table
+    _ROUTES = {
+        "/healthz": _healthz,
+        "/readyz": _readyz,
+        "/metrics": _metrics,
+        "/metrics/fleet": _fleet_metrics,
+        "/slo": _slo,
+        "/debug/flightrecorder": _flightrecorder,
+        "/debug/queues": _queues,
+        "/debug/autoscaler": _autoscaler,
+        "/debug/profile": _profile,
+        "/debug/explain": _explain,
+    }
+
+
+def _parse_query(raw_query: str) -> dict:
+    """The shared query-string parser: first value per param (no
+    endpoint takes repeated params)."""
+    return {
+        name: values[0]
+        for name, values in urllib.parse.parse_qs(raw_query).items()
+        if values
+    }
 
 
 def make_health_server(
@@ -869,6 +951,7 @@ def make_health_server(
     autoscaler_status: Optional[Callable[[], dict]] = None,
     autoscaler_history: Optional[Callable[[], list]] = None,
     profile_capture: Optional[Callable[..., dict]] = None,
+    explain_lookup: Optional[Callable[..., dict]] = None,
 ) -> ThreadingHTTPServer:
     """Build the manager's health endpoint (bind port 0 in tests);
     call ``serve_forever`` on a daemon thread to serve.  ``gc_status``
@@ -892,6 +975,12 @@ def make_health_server(
     server.autoscaler_status = autoscaler_status or (lambda: {"enabled": False})
     server.autoscaler_history = autoscaler_history or (lambda: [])
     server.profile_capture = profile_capture or obs_stackprof.capture
+    # /debug/explain (ISSUE 15): default to the installed process
+    # engine (an unwired default engine knows no workers and answers
+    # not-managed — graceful, never a 500)
+    server.explain_lookup = explain_lookup or (
+        lambda key, controller=None: obs_explain.engine().explain(key, controller)
+    )
     server.metrics_registry = (
         metrics_registry if metrics_registry is not None else obs_metrics.registry()
     )

@@ -78,7 +78,8 @@ class Journey:
 
     __slots__ = (
         "controller", "key", "generation", "trigger", "started",
-        "attempts", "requeues", "parks", "handoffs", "last_stage", "serial",
+        "attempts", "requeues", "parks", "handoffs", "last_stage",
+        "last_reason", "serial",
     )
 
     def __init__(self, controller: str, key: str, generation: int,
@@ -94,6 +95,7 @@ class Journey:
         self.parks = 0
         self.handoffs = 0
         self.last_stage = STAGE_ENQUEUED
+        self.last_reason = ""
 
     @property
     def id(self) -> str:
@@ -112,6 +114,7 @@ class Journey:
             "parks": self.parks,
             "handoffs": self.handoffs,
             "last_stage": self.last_stage,
+            "last_reason": self.last_reason,
         }
 
 
@@ -187,14 +190,21 @@ class JourneyTracker:
     # ------------------------------------------------------------------
     # in-flight stamps
     # ------------------------------------------------------------------
-    def stage(self, controller: str, key: str, stage: str) -> None:
+    def stage(
+        self, controller: str, key: str, stage: str, reason: str = ""
+    ) -> None:
         """A mid-journey stamp (requeued / parked / settle outcomes).
         Unknown keys still count the stage — the flow counters must see
-        every requeue even when the open stamp was dropped."""
+        every requeue even when the open stamp was dropped.  ``reason``
+        is the structured explain-catalog code attached at the
+        requeue/park site; the explain plane reads it back as the
+        journey's last known cause."""
         with self._lock:
             journey = self._inflight.get((controller, key))
             if journey is not None:
                 journey.last_stage = stage
+                if reason:
+                    journey.last_reason = reason
                 if stage == STAGE_REQUEUED:
                     journey.requeues += 1
                 elif stage == STAGE_PARKED:
@@ -258,6 +268,20 @@ class JourneyTracker:
         with self._lock:
             journey = self._inflight.get((controller, key))
             return journey.id if journey is not None else None
+
+    def view(self, controller: str, key: str) -> Optional[dict]:
+        """One journey's snapshot dict (None when not in flight) — a
+        single dict get, the explain plane's O(1) per-key read."""
+        now = self._clock()
+        with self._lock:
+            journey = self._inflight.get((controller, key))
+            return journey.to_dict(now) if journey is not None else None
+
+    def inflight_keys(self) -> list[tuple[str, str]]:
+        """Every in-flight (controller, key) — the explain plane's
+        blocked-histogram sweep (O(unconverged), never per-lookup)."""
+        with self._lock:
+            return list(self._inflight)
 
     def inflight(self, controller: Optional[str] = None) -> int:
         with self._lock:

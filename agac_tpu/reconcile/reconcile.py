@@ -31,7 +31,7 @@ from typing import Any, Callable
 from .. import clockseam, klog
 from ..cloudprovider.aws import health as api_health
 from ..errors import NoRetryError, NotFoundError, is_no_retry
-from ..observability import instruments, journey, profile, recorder, trace
+from ..observability import explain, instruments, journey, profile, recorder, trace
 from .pending import SettleWait
 from .result import Result
 from .workqueue import RateLimitingQueue
@@ -204,9 +204,12 @@ def _reconcile_handler(
         # a failure: backoff state is untouched, and the sync-result
         # hook sees a clean pass so failure streaks reset.
         result = instruments.RESULT_PARKED
+        reason = "parked-settle"
         with profile.stage("settle-park"):
-            err.table.park(key, queue, err, controller=controller)
-        journeys.stage(controller, key, journey.STAGE_PARKED)
+            err.table.park(key, queue, err, controller=controller,
+                           reason="parked-settle")
+        journeys.stage(controller, key, journey.STAGE_PARKED,
+                       reason="parked-settle")
         klog.v(2).infof("Parked %r: %s", key, err)
         _notify(on_sync_result, key, None, 0, False)
         err = None
@@ -214,14 +217,28 @@ def _reconcile_handler(
         permanent = is_no_retry(err)
         if permanent:
             result = instruments.RESULT_PERMANENT_ERROR
+            reason = ""
             # the item will NOT be retried: its journey can never
             # converge, so drop it (the stage counter still shows it)
             journeys.drop(controller, key)
             klog.errorf("error syncing %r: %s", key, err)
         else:
             result = instruments.RESULT_ERROR
-            queue.add_rate_limited(key)
-            journeys.stage(controller, key, journey.STAGE_REQUEUED)
+            # the explain code for WHY the retry waits (ISSUE 15):
+            # circuit rejections and pacing-vs-deadline losses are
+            # backpressure, not failures — each gets its own verdict
+            if isinstance(err, api_health.CircuitOpenError):
+                reason = "circuit-open"
+                queue.add_rate_limited(key, reason="circuit-open")
+            elif (isinstance(err, api_health.DeadlineExceeded)
+                    and getattr(err, "paced", False)):
+                reason = "quota-paced"
+                queue.add_rate_limited(key, reason="quota-paced")
+            else:
+                reason = "backoff"
+                queue.add_rate_limited(key, reason="backoff")
+            journeys.stage(controller, key, journey.STAGE_REQUEUED,
+                           reason=reason)
             klog.errorf("error syncing %r, and requeued: %s", key, err)
         if isinstance(err, api_health.DeadlineExceeded):
             reconcile_metrics.deadline_exceeded.labels(controller=controller).inc()
@@ -232,24 +249,30 @@ def _reconcile_handler(
         # without touching its journey (the new owner's resync opened
         # or will close it) and without any AWS work having run
         result = instruments.RESULT_SKIPPED
+        reason = res.reason
         queue.forget(key)
         klog.v(4).infof("Skipped %r: owned by another replica's shards", key)
         _notify(on_sync_result, key, None, 0, False)
     elif res.requeue_after > 0:
         result = instruments.RESULT_REQUEUE_AFTER
+        reason = res.reason
         queue.forget(key)
-        queue.add_after(key, res.requeue_after)
-        journeys.stage(controller, key, journey.STAGE_REQUEUED)
+        queue.add_after(key, res.requeue_after, reason=res.reason)
+        journeys.stage(controller, key, journey.STAGE_REQUEUED,
+                       reason=res.reason)
         klog.infof("Successfully synced %r, but requeued after %.1fs", key, res.requeue_after)
         _notify(on_sync_result, key, None, 0, False)
     elif res.requeue:
         result = instruments.RESULT_REQUEUE
-        queue.add_rate_limited(key)
-        journeys.stage(controller, key, journey.STAGE_REQUEUED)
+        reason = res.reason
+        queue.add_rate_limited(key, reason=res.reason)
+        journeys.stage(controller, key, journey.STAGE_REQUEUED,
+                       reason=res.reason)
         klog.infof("Successfully synced %r, but requeued", key)
         _notify(on_sync_result, key, None, 0, False)
     else:
         result = instruments.RESULT_SUCCESS
+        reason = ""
         queue.forget(key)
         # a clean terminal pass closes the journey: the object's spec
         # is verified converged (or its teardown finished) — this is
@@ -279,6 +302,8 @@ def _reconcile_handler(
             controller=controller,
             key=key,
             result=result,
+            reason=reason,
+            ring_epoch=explain.ring_epoch(),
             duration=round(elapsed, 4),
             error=str(err) if err is not None else "",
             journey=journey_id or "",

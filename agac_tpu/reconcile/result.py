@@ -19,3 +19,9 @@ class Result:
     # residue across a drain/handoff or a lease steal): forget it
     # WITHOUT closing its journey — the new owner's resync carries it
     skip: bool = False
+    # structured explain-catalog reason code (explain.REASON_CODES) for
+    # WHY the requeue/skip happened — the explain plane's blocked-on
+    # verdict reads it back from the queue/journey, never inferring.
+    # The unexplained-requeue lint rule requires every requeue/skip
+    # Result in controllers/ and reconcile/ to carry a literal code.
+    reason: str = ""

@@ -215,6 +215,14 @@ class RateLimitingQueue:
         # delayed adds: heap of (ready_monotonic_time, seq, item)
         self._waiting: list = []
         self._seq = 0
+        # explain-plane side tables (ISSUE 15), both O(1) per key:
+        # item -> eta of its LATEST delayed add (matched on pop so a
+        # superseded entry's maturation does not clear a newer one),
+        # and item -> last structured reason code attached at the
+        # requeue site (cleared on forget — a converged item carries
+        # no stale cause)
+        self._waiting_eta: dict[Hashable, float] = {}
+        self._reasons: dict[Hashable, str] = {}
         # the delay waker is a real thread ONLY when the runtime allows
         # threads; under the sim runtime (ISSUE 7) delayed adds are
         # popped synchronously by the cooperative scheduler via
@@ -315,15 +323,22 @@ class RateLimitingQueue:
             return self._shutting_down
 
     # ---- DelayingInterface ----
-    def add_after(self, item: Hashable, delay: float) -> None:
+    def add_after(self, item: Hashable, delay: float, reason: str = "") -> None:
         if delay <= 0:
+            if reason:
+                with self._mutex:
+                    self._reasons[item] = reason
             self.add(item)
             return
         with self._mutex:
             if self._shutting_down:
                 return
             self._seq += 1
-            heapq.heappush(self._waiting, (self._clock() + delay, self._seq, item))
+            eta = self._clock() + delay
+            heapq.heappush(self._waiting, (eta, self._seq, item))
+            self._waiting_eta[item] = eta
+            if reason:
+                self._reasons[item] = reason
             self._delay.notify()
 
     def kick_delays(self) -> None:
@@ -356,7 +371,11 @@ class RateLimitingQueue:
     def _pop_due_locked(self) -> None:
         now = self._clock()
         while self._waiting and self._waiting[0][0] <= now:
-            _, _, item = heapq.heappop(self._waiting)
+            ready_time, _, item = heapq.heappop(self._waiting)
+            # only the LATEST delayed add owns the eta entry; a
+            # superseded (earlier) entry maturing must not clear it
+            if self._waiting_eta.get(item) == ready_time:
+                del self._waiting_eta[item]
             self._add_locked(item)
 
     def debug_status(self) -> dict:
@@ -387,12 +406,41 @@ class RateLimitingQueue:
                 self._delay.wait(wait_for)
 
     # ---- RateLimitingInterface ----
-    def add_rate_limited(self, item: Hashable) -> None:
+    def add_rate_limited(self, item: Hashable, reason: str = "") -> None:
         self._m_retries.inc()
-        self.add_after(item, self._limiter.when(item))
+        self.add_after(item, self._limiter.when(item), reason=reason)
 
     def forget(self, item: Hashable) -> None:
         self._limiter.forget(item)
+        with self._mutex:
+            self._reasons.pop(item, None)
 
     def num_requeues(self, item: Hashable) -> int:
         return self._limiter.num_requeues(item)
+
+    # ---- explain plane (ISSUE 15) ----
+    def delayed_peek(self, item: Hashable) -> Optional[dict]:
+        """If ``item`` currently sits in a delayed add, its next-eta,
+        last reason code and backoff count — a dict get, O(1) in queue
+        and fleet size (the explain plane's per-key probe).  None when
+        the item is not delayed (ready/processing/absent)."""
+        with self._mutex:
+            eta = self._waiting_eta.get(item)
+            if eta is None:
+                return None
+            return {
+                "eta_s": round(max(0.0, eta - self._clock()), 3),
+                "reason": self._reasons.get(item, ""),
+                "requeues": self._limiter.num_requeues(item),
+            }
+
+    def contains(self, item: Hashable) -> bool:
+        """True when the item is ready, dirty, or being processed
+        (NOT delayed — ``delayed_peek`` answers that) — O(1) set
+        membership for the explain plane."""
+        with self._mutex:
+            return item in self._dirty or item in self._processing
+
+    def last_reason(self, item: Hashable) -> str:
+        with self._mutex:
+            return self._reasons.get(item, "")

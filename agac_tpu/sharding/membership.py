@@ -273,6 +273,32 @@ class ShardFilter:
             return s_old not in view.drained
         return False
 
+    def explain_key(self, key: str) -> dict:
+        """The explain plane's ownership probe: ``owns_key``'s verdict
+        PLUS why — the key's shard(s), whether it is mid-move in a live
+        resize, and which side of the drain/handoff protocol this
+        replica sits on.  Same memoized lookups as ``owns_key``; O(1)
+        per key."""
+        ring = self._current_ring()
+        if ring is None:
+            return {"owned": True, "shard": 0, "moving": False}
+        view = self._transition() if self._transition is not None else None
+        owned = self._owned()
+        if view is None:
+            shard = self._shard_of(ring, key)
+            return {"owned": shard in owned, "shard": shard, "moving": False}
+        s_old = self._shard_of(view.old_ring, key)
+        s_new = self._shard_of(view.new_ring, key)
+        info = {
+            "shard": s_old,
+            "target_shard": s_new,
+            "moving": s_old != s_new,
+            "drained_here": s_old in owned and s_old in view.drained,
+            "adopting_here": s_new in owned,
+        }
+        info["owned"] = self.owns_key(key)
+        return info
+
     def owns(self, namespace: str, name: str) -> bool:
         return self.owns_key(f"{namespace}/{name}")
 

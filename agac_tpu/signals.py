@@ -43,6 +43,14 @@ def setup_signal_handler() -> threading.Event:
             profiler().log_top()
         except Exception:
             pass
+        # blocked-on table (ISSUE 15): what the fleet was stuck on at
+        # the moment of death — same containment.
+        try:
+            from .observability.explain import engine
+
+            engine().log_top_blocked()
+        except Exception:
+            pass
         stop.set()
 
     signal.signal(signal.SIGINT, handler)

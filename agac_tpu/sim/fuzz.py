@@ -57,6 +57,8 @@ from .harness import SimHarness, SimHarnessConfig
 from .oracles import (
     CircuitBudgetOracle,
     GCDeletionOracle,
+    arm_explain_probes,
+    check_explain,
     check_resize_handoffs,
     check_slo,
     standard_oracles,
@@ -83,7 +85,13 @@ _CRASHABLE_OPS = [
     "change_resource_record_sets",
 ]
 
-CANARIES = ("drop-txt-delete", "gc-stale-owner-cache", "slo-brownout")
+CANARIES = (
+    "drop-txt-delete", "gc-stale-owner-cache", "slo-brownout", "explain-lie",
+)
+
+# the slo-brownout / explain-lie canaries' scripted GA outage window
+# (virtual seconds) — the explain oracle fuzzes its checkpoints inside
+_BROWNOUT_WINDOW = (60.0, 660.0)
 
 
 @dataclass
@@ -189,15 +197,46 @@ def _install_canary(harness: SimHarness, canary: str) -> None:
         harness.slo_engine.shed_gates = True
         ops = sorted(GA_OPS)
         harness.after(
-            60.0,
+            _BROWNOUT_WINDOW[0],
             lambda: harness.fault_plan.outage(*ops),
             "canary:slo-brownout",
         )
         harness.after(
-            660.0,
+            _BROWNOUT_WINDOW[1],
             lambda: harness.fault_plan.restore(*ops),
             "canary:slo-brownout-end",
         )
+    elif canary == "explain-lie":
+        # the explain oracle's mutation test (ISSUE 15): the same GA
+        # brownout as slo-brownout, but every stack's classifier is
+        # wrapped to swear everything is converged.  check_explain
+        # must catch the lie (unconverged objects vouched for) — a
+        # scenario where this canary passes means the oracle is blind.
+        ops = sorted(GA_OPS)
+        harness.after(
+            _BROWNOUT_WINDOW[0],
+            lambda: harness.fault_plan.outage(*ops),
+            "canary:explain-lie",
+        )
+        harness.after(
+            _BROWNOUT_WINDOW[1],
+            lambda: harness.fault_plan.restore(*ops),
+            "canary:explain-lie-end",
+        )
+
+        def lie(h, stack):
+            engine = stack.manager.explain_engine
+            if engine is None:
+                return
+
+            def lying_classify(controller, key, _orig=engine.classify):
+                answer = _orig(controller, key)
+                answer["verdict"] = "converged"
+                return answer
+
+            engine.classify = lying_classify
+
+        harness.on_stack_built = lie
     else:
         raise ValueError(f"unknown canary {canary!r} (have {CANARIES})")
 
@@ -272,6 +311,20 @@ def run_scenario(
             harness.aws.add_hosted_zone("example.com")
             if canary is not None:
                 _install_canary(harness, canary)
+            if canary in ("slo-brownout", "explain-lie"):
+                # explain checkpoints (ISSUE 15), fuzzed inside the
+                # scripted outage: mid-brownout every unconverged
+                # object must classify to a brownout-shaped verdict
+                probe_times = sorted(
+                    rng.uniform(
+                        _BROWNOUT_WINDOW[0] + 90.0,
+                        _BROWNOUT_WINDOW[1] - 30.0,
+                    )
+                    for _ in range(3)
+                )
+                arm_explain_probes(
+                    harness, probe_times, context={"outage": _BROWNOUT_WINDOW}
+                )
             gc_oracle = GCDeletionOracle(config.cluster_name).attach(harness)
             harness.run_for(15.0)  # leadership + initial sync
             gc_oracle.prime()
@@ -314,6 +367,8 @@ def run_scenario(
             slo_violations = check_slo(harness)
             if no_faults or canary == "slo-brownout":
                 violations += slo_violations
+            if canary in ("slo-brownout", "explain-lie"):
+                violations += check_explain(harness)
             try:
                 watchdog.assert_clean()
             except AssertionError as err:
@@ -389,6 +444,16 @@ def run_resize_scenario(
             harness.after(
                 resize_at, lambda: harness.request_resize(4), "resize-to-4"
             )
+            # explain checkpoints (ISSUE 15) fuzzed into and past the
+            # transition window: each replica's answer must agree with
+            # its own shard filter (owners never disclaim, non-owners
+            # answer not-owner/unowned-resize) while keys are moving
+            probe_times = sorted(
+                resize_at + rng.uniform(2.0, 150.0) for _ in range(3)
+            )
+            arm_explain_probes(
+                harness, probe_times, context={"sharded": True}
+            )
             if not no_faults:
                 # replica death composed INTO the transition window
                 kill_at = resize_at + rng.uniform(
@@ -428,6 +493,7 @@ def run_resize_scenario(
                     f"{shape.heal_seconds}s virtual heal window"
                 )
             violations += standard_oracles(harness, config.cluster_name)
+            violations += check_explain(harness)
             if not harness.resize_settled(4):
                 violations.append(
                     f"resize: fleet never settled at 4 shards under faults: "

@@ -33,6 +33,7 @@ from __future__ import annotations
 from .. import apis
 from ..cloudprovider.aws.driver import parse_route53_owner_value
 from ..controllers.globalaccelerator import is_managed_ingress, is_managed_service
+from ..observability import explain as explain_plane
 
 OWNER_TAG = "aws-global-accelerator-owner"
 RR_TYPE_A = "A"
@@ -244,6 +245,191 @@ def check_slo(harness) -> list[str]:
     if engine is None:
         return ["slo: harness has no SLO engine (slo_eval_interval 0?)"]
     return engine.violations()
+
+
+def arm_explain_probes(harness, times, context=None) -> None:
+    """Schedule the explain oracle's checkpoints (ISSUE 15): at each
+    virtual time in ``times`` (fuzzed by the scenario), every managed
+    object's fleet-merged ``/debug/explain`` verdict is checked against
+    ground truth the probe derives independently — AWS state for
+    convergence, the settle tables for parks, the shard filters for
+    ownership.  Violations accumulate on the harness; ``check_explain``
+    surfaces them at the end.
+
+    ``context`` keys: ``outage`` — a ``(start, end)`` virtual-time
+    window during which unconverged objects must classify to a
+    brownout-shaped verdict; ``sharded`` — arm the per-replica
+    ownership-consistency check."""
+    context = dict(context or {})
+    if not hasattr(harness, "explain_violations"):
+        harness.explain_violations = []
+        harness.explain_probes = 0
+
+    def probe():
+        harness.explain_probes += 1
+        harness.explain_violations.extend(
+            _explain_ground_truth_violations(harness, context)
+        )
+
+    for t in times:
+        harness.after(max(0.0, float(t)), probe, name="explain-probe")
+
+
+def check_explain(harness) -> list[str]:
+    """The explain-plane oracle's final gate: every probe's violations,
+    plus a guard that the armed probes actually fired (a scenario that
+    quiesces before its checkpoints proves nothing)."""
+    violations = list(getattr(harness, "explain_violations", []))
+    if not getattr(harness, "explain_probes", 0):
+        violations.append(
+            "explain: probes were armed but none fired before the "
+            "scenario ended"
+        )
+    return violations
+
+
+# the brownout-shaped verdicts: what an unconverged object may look
+# like while the backend is dark (everything except ownership /
+# informer / terminal answers)
+_BROWNOUT_VERDICTS = frozenset({
+    explain_plane.VERDICT_CIRCUIT_OPEN,
+    explain_plane.VERDICT_PARKED_SETTLE,
+    explain_plane.VERDICT_QUOTA_PACED,
+    explain_plane.VERDICT_BACKOFF,
+    explain_plane.VERDICT_IN_FLIGHT,
+    explain_plane.VERDICT_SHED,
+})
+# a parked key may simultaneously be circuit-blocked under another
+# controller; most-blocking ranks those above parked-settle
+_PARKED_OK_VERDICTS = frozenset({
+    explain_plane.VERDICT_PARKED_SETTLE,
+    explain_plane.VERDICT_CIRCUIT_OPEN,
+    explain_plane.VERDICT_QUOTA_PACED,
+})
+_OWNERSHIP_VERDICTS = frozenset({
+    explain_plane.VERDICT_NOT_OWNER,
+    explain_plane.VERDICT_UNOWNED_RESIZE,
+})
+
+
+def _explain_ground_truth_violations(harness, context) -> list[str]:
+    """One checkpoint's worth of explain-vs-ground-truth comparison."""
+    violations: list[str] = []
+    stacks = [
+        stack
+        for stack in harness.live_stacks()
+        if getattr(stack.manager, "explain_engine", None) is not None
+    ]
+    if not stacks:
+        return violations
+    now = harness.scheduler.monotonic()
+    stamp = f"t={now:.0f}"
+    blocked = frozenset(explain_plane.BLOCKED_VERDICTS)
+
+    def fleet_explain(key: str) -> tuple[dict, dict]:
+        answers = {}
+        for stack in stacks:
+            try:
+                answers[stack.identity] = stack.manager.explain_engine.explain(key)
+            except Exception as err:  # an explain crash is itself a finding
+                answers[stack.identity] = {"error": str(err)}
+        return explain_plane.merge_fleet_explains(answers), answers
+
+    # ground truth #1: AWS state — a managed object whose accelerator
+    # chain is absent is unconverged, whatever the classifier claims
+    want = expected_owners(harness.cluster)
+    have = {
+        owner
+        for owner in harness.aws.accelerator_owners().values()
+        if owner is not None
+    }
+    outage = context.get("outage")
+    in_outage = bool(outage) and outage[0] <= now <= outage[1]
+    brownout_evidence: list[str] = []
+    for owner in sorted(want):
+        _, namespace, name = owner.split("/", 2)
+        key = f"{namespace}/{name}"
+        merged, answers = fleet_explain(key)
+        verdict = merged["verdict"]
+        if verdict not in explain_plane.VERDICTS:
+            violations.append(
+                f"explain: {stamp} {key}: verdict {verdict!r} is outside "
+                "the closed catalog"
+            )
+            continue
+        unconverged = owner not in have
+        if unconverged and verdict not in blocked:
+            violations.append(
+                f"explain: {stamp} {key} has no accelerator chain yet the "
+                f"fleet-merged verdict is {verdict!r} — the classifier is "
+                "vouching for convergence that has not happened"
+            )
+        if unconverged and in_outage and verdict not in _BROWNOUT_VERDICTS:
+            violations.append(
+                f"explain: {stamp} {key} is unconverged mid-brownout but "
+                f"classifies {verdict!r}, not a brownout-shaped verdict "
+                f"{sorted(_BROWNOUT_VERDICTS)}"
+            )
+        if unconverged and in_outage:
+            brownout_evidence.append(verdict)
+        # ground truth #3: per-replica ownership — a replica whose
+        # shard filter disclaims the key must answer not-owner /
+        # unowned-resize, and an owner must never disclaim it
+        if context.get("sharded"):
+            for stack in stacks:
+                answer = answers.get(stack.identity)
+                if not isinstance(answer, dict) or "error" in answer:
+                    violations.append(
+                        f"explain: {stamp} {key}: replica {stack.identity} "
+                        f"failed to answer: {answer.get('error') if isinstance(answer, dict) else answer}"
+                    )
+                    continue
+                shard_filter = stack.manager.shard_filter
+                if shard_filter is None:
+                    continue
+                owned = shard_filter.owns_key(key)
+                replica_verdict = answer.get("verdict")
+                if owned and replica_verdict in _OWNERSHIP_VERDICTS:
+                    violations.append(
+                        f"explain: {stamp} {key}: {stack.identity} owns the "
+                        f"key but answered {replica_verdict!r}"
+                    )
+                elif not owned and replica_verdict not in _OWNERSHIP_VERDICTS:
+                    violations.append(
+                        f"explain: {stamp} {key}: {stack.identity} does not "
+                        f"own the key but answered {replica_verdict!r} "
+                        "instead of not-owner/unowned-resize"
+                    )
+
+    # mid-brownout with circuits actually open, SOMETHING unconverged
+    # must pin the blame on the breaker (or a park) — an explain plane
+    # that never says circuit-open during an outage is not explaining
+    if (
+        in_outage
+        and brownout_evidence
+        and harness.world.health.open_services()
+        and not any(
+            v in (explain_plane.VERDICT_CIRCUIT_OPEN,
+                  explain_plane.VERDICT_PARKED_SETTLE)
+            for v in brownout_evidence
+        )
+    ):
+        violations.append(
+            f"explain: {stamp} circuits are open mid-brownout yet none of "
+            f"{len(brownout_evidence)} unconverged objects classifies "
+            f"circuit-open/parked-settle (saw {sorted(set(brownout_evidence))})"
+        )
+
+    # ground truth #2: the settle tables — a parked key IS parked
+    for table in harness.settle_tables():
+        for key in table.parked_keys():
+            merged, _ = fleet_explain(key)
+            if merged["verdict"] not in _PARKED_OK_VERDICTS:
+                violations.append(
+                    f"explain: {stamp} {key} is parked in the settle table "
+                    f"but classifies {merged['verdict']!r}"
+                )
+    return violations
 
 
 def check_autoscaler_oscillation(

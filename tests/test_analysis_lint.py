@@ -978,7 +978,7 @@ class TestJourneyStageWithoutStamp:
             run(
                 """
                 def _handle(key, queue):
-                    queue.add_rate_limited(key)
+                    queue.add_rate_limited(key, reason="backoff")
                 """,
                 path="agac_tpu/reconcile/loop.py",
             ),
@@ -991,7 +991,7 @@ class TestJourneyStageWithoutStamp:
             run(
                 """
                 def _handle(key, queue, table, wait):
-                    table.park(key, queue, wait)
+                    table.park(key, queue, wait, reason="parked-settle")
                 """,
                 path="agac_tpu/reconcile/loop.py",
             ),
@@ -1007,7 +1007,7 @@ class TestJourneyStageWithoutStamp:
 
                 def _handle(key, queue):
                     journey.tracker().stage("ctrl", key, "requeued")
-                    queue.add_rate_limited(key)
+                    queue.add_rate_limited(key, reason="backoff")
                 """,
                 path="agac_tpu/reconcile/loop.py",
             )
@@ -1020,7 +1020,7 @@ class TestJourneyStageWithoutStamp:
                 """
                 def _expire(entry, journeys):
                     journeys.drop("ctrl", entry.key)
-                    entry.queue.add_after(entry.key, 5.0)
+                    entry.queue.add_after(entry.key, 5.0, reason="backoff")
                 """,
                 path="agac_tpu/reconcile/pending_extra.py",
             )
@@ -1048,7 +1048,7 @@ class TestJourneyStageWithoutStamp:
             run(
                 """
                 def _enqueue(self, queue, obj):
-                    queue.add_rate_limited(key(obj))
+                    queue.add_rate_limited(key(obj), reason="backoff")
                 """,
                 path="agac_tpu/controllers/somecontroller.py",
             )
@@ -1058,13 +1058,145 @@ class TestJourneyStageWithoutStamp:
     def test_suppression_needs_justification(self):
         src = """
         def _handle(key, queue):
-            queue.add_rate_limited(key)  # agac-lint: ignore[journey-stage-without-stamp] -- test-only shim queue
+            queue.add_rate_limited(key, reason="backoff")  # agac-lint: ignore[journey-stage-without-stamp] -- test-only shim queue
         """
         assert run(src, path="agac_tpu/reconcile/loop.py") == []
         bare = src.replace(" -- test-only shim queue", "")
         assert run(bare, path="agac_tpu/reconcile/loop.py"), (
             "suppression without justification must not hold"
         )
+
+
+# ---------------------------------------------------------------------------
+# unexplained-requeue
+# ---------------------------------------------------------------------------
+
+
+class TestUnexplainedRequeue:
+    """The explain plane's feed gate (ISSUE 15): every requeue, park,
+    and fate-carrying Result at a reconcile/controller call site must
+    state a reason code the explain catalog can classify."""
+
+    def test_missing_reason_fires_once(self):
+        v = only(
+            run(
+                """
+                def _handle(key, queue, journeys):
+                    journeys.stage("ctrl", key, "requeued")
+                    queue.add_rate_limited(key)
+                """,
+                path="agac_tpu/reconcile/loop.py",
+            ),
+            "unexplained-requeue",
+        )
+        assert "add_rate_limited" in v.message and "reason" in v.message
+
+    def test_computed_reason_fires_once(self):
+        v = only(
+            run(
+                """
+                def _handle(key, queue, journeys, why):
+                    journeys.stage("ctrl", key, "requeued")
+                    queue.add_after(key, 5.0, reason="re-" + why)
+                """,
+                path="agac_tpu/reconcile/loop.py",
+            ),
+            "unexplained-requeue",
+        )
+        assert "literal" in v.message
+
+    def test_uncataloged_literal_fires_once(self):
+        v = only(
+            run(
+                """
+                def _handle(key, queue, journeys):
+                    journeys.stage("ctrl", key, "requeued")
+                    queue.add_rate_limited(key, reason="because-reasons")
+                """,
+                path="agac_tpu/reconcile/loop.py",
+            ),
+            "unexplained-requeue",
+        )
+        assert "because-reasons" in v.message
+
+    def test_cataloged_literal_is_clean(self):
+        assert (
+            run(
+                """
+                def _handle(key, queue, journeys):
+                    journeys.stage("ctrl", key, "requeued")
+                    queue.add_rate_limited(key, reason="circuit-open")
+                """,
+                path="agac_tpu/reconcile/loop.py",
+            )
+            == []
+        )
+
+    def test_result_reason_passthrough_is_clean(self):
+        # the reconcile loop relays the controller's own verdict:
+        # res.reason is attribute provenance, not a new decision
+        assert (
+            run(
+                """
+                def _handle(key, queue, journeys, res):
+                    journeys.stage("ctrl", key, "requeued")
+                    queue.add_rate_limited(key, reason=res.reason)
+                """,
+                path="agac_tpu/reconcile/loop.py",
+            )
+            == []
+        )
+
+    def test_result_fate_without_reason_fires_once(self):
+        v = only(
+            run(
+                """
+                def reconcile_widget(obj) -> "Result":
+                    return Result(requeue_after=30.0)
+                """,
+                path="agac_tpu/controllers/widget.py",
+            ),
+            "unexplained-requeue",
+        )
+        assert "Result" in v.message
+        assert (
+            run(
+                """
+                def reconcile_widget(obj) -> "Result":
+                    return Result(requeue_after=30.0, reason="in-flight")
+                """,
+                path="agac_tpu/controllers/widget.py",
+            )
+            == []
+        )
+
+    def test_workqueue_mechanism_and_other_packages_are_exempt(self):
+        src = """
+        def requeue_internal(self, item):
+            self.add_rate_limited(item)
+        """
+        assert run(src, path="agac_tpu/reconcile/workqueue.py") == []
+        assert run(src, path="agac_tpu/observability/journey.py") == []
+
+    def test_suppression_needs_justification(self):
+        src = """
+        def _handle(key, queue, journeys):
+            journeys.stage("ctrl", key, "requeued")
+            queue.add_rate_limited(key)  # agac-lint: ignore[unexplained-requeue] -- reason attached upstream by shim
+        """
+        assert run(src, path="agac_tpu/reconcile/loop.py") == []
+        bare = src.replace(" -- reason attached upstream by shim", "")
+        assert run(bare, path="agac_tpu/reconcile/loop.py"), (
+            "suppression without justification must not hold"
+        )
+
+    def test_reason_catalog_matches_the_explain_plane(self):
+        # the rule's literal copy (the linter never imports the linted
+        # package) must track the explain catalog exactly
+        from agac_tpu.analysis.rules import _REQUEUE_REASON_CODES
+        from agac_tpu.observability import explain
+
+        assert _REQUEUE_REASON_CODES == explain.REASON_CODES
 
 
 # ---------------------------------------------------------------------------
@@ -1179,6 +1311,7 @@ def test_rule_registry_ships_the_documented_rules():
         "cross-shard-sweep",
         "journey-stage-without-stamp",
         "unattributed-stage",
+        "unexplained-requeue",
     }
 
 

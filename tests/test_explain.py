@@ -1,0 +1,470 @@
+"""Unit tier for the convergence explain plane (ISSUE 15):
+``agac_tpu/observability/explain.py`` — one test per verdict in the
+closed catalog, causal-timeline assembly order, fleet-merge
+owner/non-owner resolution, the O(1)-per-key lookup micro-assert, and
+the ``agac_explain_blocked`` gauge exposition round-trip.  The live
+wiring (manager endpoint, reconcile reason threading, SIGTERM table)
+is covered by tests/test_profiling.py, tests/test_observability.py
+and the sim explain oracle.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from agac_tpu.errors import NotFoundError
+from agac_tpu.observability import explain, journey
+from agac_tpu.observability.metrics import MetricsRegistry, parse_text
+from agac_tpu.observability.recorder import FlightRecorder
+from agac_tpu.reconcile.pending import PendingSettleTable, SettleWait
+from agac_tpu.reconcile.workqueue import RateLimitingQueue
+
+SVC = "global-accelerator-controller-service"
+ING = "global-accelerator-controller-ingress"
+KEY = "default/app"
+
+
+class FakeClock:
+    def __init__(self, now: float = 100.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+class FakeHealth:
+    def open_services(self):
+        return ["globalaccelerator"]
+
+
+class FakeShardFilter:
+    """The two ownership shapes membership.ShardFilter.explain_key
+    can disclaim a key with."""
+
+    all_shards = False
+
+    def __init__(self, answer):
+        self.answer = answer
+
+    def explain_key(self, key):
+        return dict(self.answer)
+
+
+def make_engine(clock=None, **kwargs):
+    clock = clock or FakeClock()
+    reg = MetricsRegistry()
+    journeys = journey.JourneyTracker(registry=reg, clock=clock)
+    queue = RateLimitingQueue(name="svc", clock=clock, metrics_registry=reg)
+    engine = explain.ExplainEngine(
+        journeys=journeys, clock=clock, identity="replica-0", **kwargs
+    )
+    obj = object()
+    engine.register_worker(SVC, queue, lambda key: obj, managed=lambda o: True)
+    return engine, journeys, queue, clock, reg
+
+
+# ---------------------------------------------------------------------------
+# one test per verdict
+# ---------------------------------------------------------------------------
+
+
+class TestVerdicts:
+    def test_converged(self):
+        engine, _, _, _, _ = make_engine()
+        answer = engine.classify(SVC, KEY)
+        assert answer["verdict"] == explain.VERDICT_CONVERGED
+
+    def test_in_flight_queued(self):
+        engine, journeys, queue, _, _ = make_engine()
+        journeys.observe_enqueued(SVC, KEY)
+        queue.add(KEY)
+        answer = engine.classify(SVC, KEY)
+        assert answer["verdict"] == explain.VERDICT_IN_FLIGHT
+        assert answer["detail"]["queue"] == "ready-or-processing"
+
+    def test_in_flight_between_queue_moves(self):
+        engine, journeys, _, _, _ = make_engine()
+        journeys.observe_enqueued(SVC, KEY)
+        assert engine.classify(SVC, KEY)["verdict"] == explain.VERDICT_IN_FLIGHT
+
+    def test_in_flight_scheduled_recheck(self):
+        # a retry_after hint carries reason="in-flight": forward
+        # progress on the AWS side, not an error backoff
+        engine, journeys, queue, _, _ = make_engine()
+        journeys.observe_enqueued(SVC, KEY)
+        queue.add_after(KEY, 30.0, reason="in-flight")
+        assert engine.classify(SVC, KEY)["verdict"] == explain.VERDICT_IN_FLIGHT
+
+    def test_backoff(self):
+        engine, journeys, queue, _, _ = make_engine()
+        journeys.observe_enqueued(SVC, KEY)
+        queue.add_rate_limited(KEY, reason="backoff")
+        answer = engine.classify(SVC, KEY)
+        assert answer["verdict"] == explain.VERDICT_BACKOFF
+        assert answer["detail"]["delayed"]["requeues"] == 1
+        assert answer["detail"]["delayed"]["eta_s"] >= 0
+
+    def test_backoff_is_the_unreasoned_delay_default(self):
+        engine, journeys, queue, _, _ = make_engine()
+        journeys.observe_enqueued(SVC, KEY)
+        queue.add_after(KEY, 12.0)
+        assert engine.classify(SVC, KEY)["verdict"] == explain.VERDICT_BACKOFF
+
+    def test_circuit_open(self):
+        engine, journeys, queue, _, _ = make_engine(health=FakeHealth())
+        journeys.observe_enqueued(SVC, KEY)
+        queue.add_after(KEY, 15.0, reason="circuit-open")
+        answer = engine.classify(SVC, KEY)
+        assert answer["verdict"] == explain.VERDICT_CIRCUIT_OPEN
+        assert answer["detail"]["open_circuits"] == ["globalaccelerator"]
+
+    def test_quota_paced(self):
+        engine, journeys, queue, _, _ = make_engine()
+        journeys.observe_enqueued(SVC, KEY)
+        queue.add_after(KEY, 5.0, reason="quota-paced")
+        assert engine.classify(SVC, KEY)["verdict"] == explain.VERDICT_QUOTA_PACED
+
+    def test_parked_settle(self):
+        clock = FakeClock()
+        table = PendingSettleTable(clock=clock, registry=MetricsRegistry())
+        engine, journeys, queue, _, _ = make_engine(
+            clock=clock, settle_table=table
+        )
+        journeys.observe_enqueued(SVC, KEY)
+        table.park(
+            KEY, queue, SettleWait("ga-accelerator", "arn:x", timeout=180.0),
+            controller=SVC, reason="parked-settle",
+        )
+        clock.advance(30.0)
+        answer = engine.classify(SVC, KEY)
+        assert answer["verdict"] == explain.VERDICT_PARKED_SETTLE
+        parked = answer["detail"]["parked"]
+        assert parked["group"] == "ga-accelerator"
+        assert parked["parked_for_s"] == pytest.approx(30.0)
+        assert parked["deadline_in_s"] == pytest.approx(150.0)
+
+    def test_shed(self):
+        engine, journeys, _, _, _ = make_engine(slo_shedding=lambda: True)
+        journeys.observe_enqueued(SVC, KEY)
+        assert engine.classify(SVC, KEY)["verdict"] == explain.VERDICT_SHED
+
+    def test_not_owner(self):
+        engine, _, _, _, _ = make_engine(
+            shard_filter=FakeShardFilter(
+                {"owned": False, "shard": 3, "moving": False}
+            ),
+        )
+        answer = engine.classify(SVC, KEY)
+        assert answer["verdict"] == explain.VERDICT_NOT_OWNER
+        assert answer["detail"]["shard"] == 3
+
+    def test_unowned_resize(self):
+        engine, _, _, _, _ = make_engine(
+            shard_filter=FakeShardFilter({
+                "owned": False, "shard": 1, "target_shard": 3,
+                "moving": True, "drained_here": True, "adopting_here": False,
+            }),
+            resize_status=lambda: {"epoch": 7, "state": "transitioning"},
+        )
+        answer = engine.classify(SVC, KEY)
+        assert answer["verdict"] == explain.VERDICT_UNOWNED_RESIZE
+        assert answer["detail"]["ring_epoch"] == 7
+        assert answer["detail"]["resize_state"] == "transitioning"
+
+    def test_informer_unsynced(self):
+        engine, _, _, _, _ = make_engine(informers_synced=lambda: False)
+        assert (
+            engine.classify(SVC, KEY)["verdict"]
+            == explain.VERDICT_INFORMER_UNSYNCED
+        )
+
+    def test_not_managed(self):
+        engine, _, _, _, _ = make_engine()
+        engine.register_worker(
+            ING, RateLimitingQueue(name="ing", metrics_registry=MetricsRegistry()),
+            lambda key: object(), managed=lambda o: False,
+        )
+        assert engine.classify(ING, KEY)["verdict"] == explain.VERDICT_NOT_MANAGED
+
+    def test_deleted(self):
+        engine, _, _, _, _ = make_engine()
+
+        def gone(key):
+            raise NotFoundError(f"no such object {key}")
+
+        engine.register_worker(ING, None, gone, managed=None)
+        assert engine.classify(ING, KEY)["verdict"] == explain.VERDICT_DELETED
+
+    def test_never_unknown(self):
+        # the catalog is closed: every classification lands in it
+        engine, journeys, queue, _, _ = make_engine()
+        journeys.observe_enqueued(SVC, KEY)
+        queue.add_after(KEY, 1.0, reason="in-flight")
+        for controller in (SVC, "never-registered"):
+            verdict = engine.classify(controller, KEY)["verdict"]
+            assert verdict in explain.VERDICTS
+            assert verdict != "unknown"
+
+
+# ---------------------------------------------------------------------------
+# the envelope + priority
+# ---------------------------------------------------------------------------
+
+
+class TestEnvelope:
+    def test_summary_is_most_blocking_across_controllers(self):
+        engine, journeys, queue, _, _ = make_engine()
+        # SVC converged; ING circuit-blocked → summary circuit-open
+        ing_queue = RateLimitingQueue(name="ing", metrics_registry=MetricsRegistry())
+        engine.register_worker(ING, ing_queue, lambda key: object(), managed=None)
+        journeys.observe_enqueued(ING, KEY)
+        ing_queue.add_after(KEY, 15.0, reason="circuit-open")
+        answer = engine.explain(KEY)
+        assert answer["verdict"] == explain.VERDICT_CIRCUIT_OPEN
+        assert set(answer["controllers"]) == {SVC, ING}
+
+    def test_converged_outranks_another_controllers_not_managed(self):
+        # one controller converged it, another's predicate rejects it:
+        # the object IS converged
+        engine, _, _, _, _ = make_engine()
+        engine.register_worker(
+            ING, None, lambda key: object(), managed=lambda o: False
+        )
+        assert engine.explain(KEY)["verdict"] == explain.VERDICT_CONVERGED
+
+    def test_unknown_controller_raises_keyerror(self):
+        engine, _, _, _, _ = make_engine()
+        with pytest.raises(KeyError):
+            engine.explain(KEY, controller="no-such-worker")
+
+    def test_empty_engine_cannot_vouch_for_convergence(self):
+        empty = explain.ExplainEngine(
+            journeys=journey.JourneyTracker(registry=MetricsRegistry()),
+            clock=FakeClock(),
+        )
+        assert empty.explain(KEY)["verdict"] == explain.VERDICT_NOT_MANAGED
+
+
+# ---------------------------------------------------------------------------
+# timeline assembly
+# ---------------------------------------------------------------------------
+
+
+class TestTimeline:
+    def test_ordering_enqueue_then_recorder_then_current_wait(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(capacity=16, clock=clock)
+        engine, journeys, queue, _, _ = make_engine(
+            clock=clock, flight_recorder=recorder
+        )
+        journeys.observe_enqueued(SVC, KEY)
+        recorder.record(
+            "reconcile", controller=SVC, key=KEY, result="requeued",
+            reason="backoff", ring_epoch=2, duration=0.5,
+        )
+        recorder.record(  # another key: filtered out
+            "reconcile", controller=SVC, key="default/other", result="ok",
+        )
+        recorder.record(  # another controller: filtered out
+            "reconcile", controller=ING, key=KEY, result="ok",
+        )
+        recorder.record("gc-sweep", key=KEY)  # controller "": kept
+        journeys.stage(SVC, KEY, journey.STAGE_REQUEUED, reason="backoff")
+        queue.add_rate_limited(KEY, reason="backoff")
+
+        timeline = engine.classify(SVC, KEY)["timeline"]
+        events = [e["event"] for e in timeline]
+        assert events[0] == "enqueued"
+        assert events[-1] == "last-stage"
+        assert events[1:-1] == ["reconcile", "gc-sweep"]
+        entry = timeline[1]
+        assert entry["reason"] == "backoff"
+        assert entry["ring_epoch"] == 2
+        assert entry["duration"] == 0.5
+        # recorder entries ride oldest → newest
+        assert timeline[1]["seq"] < timeline[2]["seq"]
+        tail = timeline[-1]
+        assert tail["stage"] == journey.STAGE_REQUEUED
+        assert tail["reason"] == "backoff"
+
+    def test_no_journey_no_timeline_noise(self):
+        engine, _, _, _, _ = make_engine(
+            flight_recorder=FlightRecorder(capacity=4)
+        )
+        assert engine.classify(SVC, KEY)["timeline"] == []
+
+
+# ---------------------------------------------------------------------------
+# fleet merge
+# ---------------------------------------------------------------------------
+
+
+def _answer(verdict, identity="r", epoch=0):
+    return {
+        "key": KEY, "identity": identity, "ring_epoch": epoch,
+        "verdict": verdict, "controllers": {},
+    }
+
+
+class TestFleetMerge:
+    def test_owner_answer_wins_over_not_owner(self):
+        merged = explain.merge_fleet_explains({
+            "peer-a": _answer(explain.VERDICT_NOT_OWNER, "a", epoch=4),
+            "peer-b": _answer(explain.VERDICT_CIRCUIT_OPEN, "b", epoch=4),
+        })
+        assert merged["verdict"] == explain.VERDICT_CIRCUIT_OPEN
+        assert merged["owner"] == "peer-b"
+        assert merged["peers"]["peer-a"]["ring_epoch"] == 4
+        assert merged["answer"]["identity"] == "b"
+
+    def test_no_owner_mid_resize(self):
+        merged = explain.merge_fleet_explains({
+            "peer-a": _answer(explain.VERDICT_NOT_OWNER),
+            "peer-b": _answer(explain.VERDICT_UNOWNED_RESIZE),
+        })
+        assert merged["owner"] is None
+        # most blocking of the non-owner shapes: the resize window
+        assert merged["verdict"] == explain.VERDICT_UNOWNED_RESIZE
+
+    def test_multiple_owner_shaped_answers_resolve_most_blocking(self):
+        # a resize race: both sides claim the key for an instant
+        merged = explain.merge_fleet_explains({
+            "peer-a": _answer(explain.VERDICT_CONVERGED, "a"),
+            "peer-b": _answer(explain.VERDICT_BACKOFF, "b"),
+        })
+        assert merged["verdict"] == explain.VERDICT_BACKOFF
+        assert merged["owner"] == "peer-b"
+
+    def test_failed_peers_reported_never_dropped(self):
+        merged = explain.merge_fleet_explains({
+            "peer-a": _answer(explain.VERDICT_CONVERGED, "a"),
+            "peer-b": {"error": "connection refused"},
+        })
+        assert merged["verdict"] == explain.VERDICT_CONVERGED
+        assert merged["peers"]["peer-b"] == {"error": "connection refused"}
+
+
+# ---------------------------------------------------------------------------
+# O(1) lookup micro-assert
+# ---------------------------------------------------------------------------
+
+
+class ProbeRecordingQueue:
+    """A queue facade that records exactly which keys the engine asks
+    about — the no-fleet-enumeration pin."""
+
+    def __init__(self):
+        self.probed: list[str] = []
+
+    def delayed_peek(self, item):
+        self.probed.append(item)
+        return None
+
+    def contains(self, item):
+        self.probed.append(item)
+        return True
+
+
+class TestO1Lookup:
+    def test_classify_consults_only_the_probed_key(self):
+        clock = FakeClock()
+        journeys = journey.JourneyTracker(registry=MetricsRegistry(), clock=clock)
+        engine = explain.ExplainEngine(journeys=journeys, clock=clock)
+        queue = ProbeRecordingQueue()
+        lookups: list[str] = []
+
+        def key_to_obj(key):
+            lookups.append(key)
+            return object()
+
+        engine.register_worker(SVC, queue, key_to_obj, managed=None)
+        # a large in-flight population the lookup must never sweep
+        for i in range(500):
+            journeys.observe_enqueued(SVC, f"default/app{i}")
+        answer = engine.explain("default/app7")
+        assert answer["controllers"][SVC]["verdict"] == explain.VERDICT_IN_FLIGHT
+        # queue consulted for the probed key only; the informer cache
+        # not at all (the journey already answered)
+        assert set(queue.probed) == {"default/app7"}
+        assert lookups == []
+
+
+# ---------------------------------------------------------------------------
+# the blocked gauge
+# ---------------------------------------------------------------------------
+
+
+class TestBlockedGauge:
+    def test_exposition_round_trip(self):
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        journeys = journey.JourneyTracker(registry=reg, clock=clock)
+        queue = RateLimitingQueue(name="svc", clock=clock, metrics_registry=reg)
+        engine = explain.ExplainEngine(journeys=journeys, clock=clock)
+        engine.register_worker(SVC, queue, lambda key: object(), managed=None)
+        engine.bind_metrics(reg)
+        for key in ("default/a", "default/b"):
+            journeys.observe_enqueued(SVC, key)
+            queue.add_after(key, 20.0, reason="backoff")
+        journeys.observe_enqueued(SVC, "default/c")
+        queue.add(KEY)  # not journeyed: contributes nothing
+
+        samples = parse_text(reg.render())
+        assert samples['agac_explain_blocked{reason="backoff"}'] == 2
+        assert samples['agac_explain_blocked{reason="in-flight"}'] == 1
+        assert samples['agac_explain_blocked{reason="circuit-open"}'] == 0
+        # every blocked verdict exports a series (zero-filled)
+        for verdict in explain.BLOCKED_VERDICTS:
+            assert f'agac_explain_blocked{{reason="{verdict}"}}' in samples
+
+    def test_counts_cached_within_ttl_then_refreshed(self):
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        journeys = journey.JourneyTracker(registry=reg, clock=clock)
+        queue = RateLimitingQueue(name="svc", clock=clock, metrics_registry=reg)
+        engine = explain.ExplainEngine(journeys=journeys, clock=clock)
+        engine.register_worker(SVC, queue, lambda key: object(), managed=None)
+        journeys.observe_enqueued(SVC, KEY)
+        assert engine.blocked_counts() == {explain.VERDICT_IN_FLIGHT: 1}
+        journeys.observe_enqueued(SVC, "default/b")
+        # within the TTL the sweep is shared, not re-run
+        assert engine.blocked_counts() == {explain.VERDICT_IN_FLIGHT: 1}
+        clock.advance(explain.BLOCKED_CACHE_TTL + 0.1)
+        assert engine.blocked_counts() == {explain.VERDICT_IN_FLIGHT: 2}
+
+    def test_query_counter_by_surface(self):
+        reg = MetricsRegistry()
+        engine = explain.ExplainEngine(
+            journeys=journey.JourneyTracker(registry=reg), clock=FakeClock()
+        )
+        engine.bind_metrics(reg)
+        engine.explain(KEY)
+        engine.explain(KEY, surface="cli")
+        engine.log_top_blocked()
+        samples = parse_text(reg.render())
+        assert samples['agac_explain_queries_total{surface="debug-endpoint"}'] == 1
+        assert samples['agac_explain_queries_total{surface="cli"}'] == 1
+        assert samples['agac_explain_queries_total{surface="post-mortem"}'] == 1
+
+
+# ---------------------------------------------------------------------------
+# catalog shape
+# ---------------------------------------------------------------------------
+
+
+class TestCatalog:
+    def test_reason_codes_are_a_subset_of_the_catalog(self):
+        assert explain.REASON_CODES <= set(explain.VERDICTS)
+
+    def test_priority_covers_the_whole_catalog_exactly(self):
+        assert sorted(explain._PRIORITY) == sorted(explain.VERDICTS)
+
+    def test_blocked_verdicts_exclude_terminal_states(self):
+        blocked = set(explain.BLOCKED_VERDICTS)
+        assert explain.VERDICT_CONVERGED not in blocked
+        assert explain.VERDICT_NOT_MANAGED not in blocked
+        assert explain.VERDICT_DELETED not in blocked
+        assert blocked <= set(explain.VERDICTS)

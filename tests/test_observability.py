@@ -283,6 +283,77 @@ class TestFlightRecorder:
         recorder.record("reconcile", obj=object())  # stored as-is, no raise
         assert len(recorder) == 1
 
+    def test_reason_and_ring_epoch_ride_through_to_the_dump(self):
+        # the explain plane's timeline (ISSUE 15) reads these fields
+        # straight off the dump — they must survive verbatim
+        recorder = FlightRecorder(capacity=4)
+        recorder.record(
+            "reconcile", controller="ctrl", key="ns/app",
+            result="requeued", reason="circuit-open", ring_epoch=3,
+        )
+        entry = recorder.dump()[-1]
+        assert entry["reason"] == "circuit-open"
+        assert entry["ring_epoch"] == 3
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM post-mortem: the blocked-on table
+# ---------------------------------------------------------------------------
+
+
+class TestSigtermPostMortem:
+    def test_handler_appends_the_top_blocked_on_table(self):
+        """The terminating pod's log gains one line per blocked-on
+        verdict (ISSUE 15), alongside the flight-recorder tail and the
+        profiler top table — and the stop event still sets."""
+        import logging
+        import signal as signal_mod
+
+        from agac_tpu import signals
+        from agac_tpu.observability import explain, journey
+
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        journeys = journey.JourneyTracker(registry=reg, clock=clock)
+        queue = RateLimitingQueue(name="pm", clock=clock, metrics_registry=reg)
+        engine = explain.ExplainEngine(journeys=journeys, clock=clock)
+        engine.register_worker("ctrl", queue, lambda key: object(), managed=None)
+        journeys.observe_enqueued("ctrl", "ns/a")
+        queue.add_after("ns/a", 30.0, reason="circuit-open")
+        journeys.observe_enqueued("ctrl", "ns/b")
+
+        records: list[str] = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = Capture()
+        logger = logging.getLogger("agac")
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        previous_engine = explain.install(engine)
+        saved_installed = signals._installed
+        saved_int = signal_mod.getsignal(signal_mod.SIGINT)
+        saved_term = signal_mod.getsignal(signal_mod.SIGTERM)
+        signals._installed = False
+        try:
+            stop = signals.setup_signal_handler()
+            signal_mod.raise_signal(signal_mod.SIGTERM)
+            assert stop.is_set()
+        finally:
+            signal_mod.signal(signal_mod.SIGINT, saved_int)
+            signal_mod.signal(signal_mod.SIGTERM, saved_term)
+            signals._installed = saved_installed
+            explain.install(previous_engine)
+            logger.removeHandler(handler)
+
+        table = [line for line in records if "blocked-on" in line]
+        assert table, records
+        assert "2 unconverged" in table[0]
+        body = "\n".join(records)
+        assert "circuit-open" in body and "in-flight" in body
+
 
 # ---------------------------------------------------------------------------
 # instrumented hot paths

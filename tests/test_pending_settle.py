@@ -49,7 +49,7 @@ class RecorderQueue:
     def add(self, key):
         self.added.append(key)
 
-    def add_rate_limited(self, key):
+    def add_rate_limited(self, key, reason=""):
         self.rate_limited.append(key)
 
     def forget(self, key):

@@ -397,7 +397,15 @@ DEBUG_ENDPOINTS = [
     ("/debug/profile?seconds=0", 200, "application/json", {"hz", "seconds", "samples", "folded", "top", "stages"}),
     ("/debug/profile?seconds=0&format=folded", 200, "text/plain", None),
     ("/debug/profile?seconds=bogus", 400, "application/json", {"error"}),
-    ("/debug/nonexistent", 404, None, None),
+    # the explain plane (ISSUE 15): missing/malformed key → 400,
+    # unknown controller → 404, well-formed key → the verdict envelope
+    ("/debug/explain", 400, "application/json", {"error"}),
+    ("/debug/explain?key=barekey", 400, "application/json", {"error"}),
+    ("/debug/explain?key=default/svc", 200, "application/json",
+     {"key", "verdict", "controllers", "identity", "ring_epoch"}),
+    ("/debug/explain?key=default/svc&controller=nope", 404, "application/json", {"error"}),
+    # the route-table 404 contract: JSON error + the endpoint list
+    ("/debug/nonexistent", 404, "application/json", {"error", "endpoints"}),
 ]
 
 

@@ -28,13 +28,13 @@ class RecordingQueue(RateLimitingQueue):
         super().__init__(name="recording")
         self.calls = []
 
-    def add_rate_limited(self, item):
+    def add_rate_limited(self, item, reason=""):
         self.calls.append(("add_rate_limited", item))
-        super().add_rate_limited(item)
+        super().add_rate_limited(item, reason=reason)
 
-    def add_after(self, item, delay):
+    def add_after(self, item, delay, reason=""):
         self.calls.append(("add_after", item, delay))
-        super().add_after(item, delay)
+        super().add_after(item, delay, reason=reason)
 
     def forget(self, item):
         self.calls.append(("forget", item))

@@ -442,6 +442,33 @@ class TestScenarioFuzzer:
             "burn-gated shedding was never observed"
         )
 
+    def test_canary_explain_lie_is_caught(self):
+        """Mutation run (ISSUE 15): a lying classifier that reports
+        every key "converged" during the same GA brownout must be
+        caught by the explain ground-truth oracle — unconverged keys
+        claiming a terminal verdict at probe time.  An explain plane
+        whose oracle cannot detect a lie proves nothing."""
+        result = fuzz.run_scenario(
+            MINI_SEED, profile="mini", canary="explain-lie", no_faults=True
+        )
+        assert not result.ok
+        assert any(v.startswith("explain:") for v in result.violations), (
+            result.violations
+        )
+
+    def test_truthful_classifier_is_clean_under_brownout(self):
+        """The explain oracle's clean half: the same brownout with the
+        real classifier must produce ZERO explain violations — probes
+        fire mid-outage and every blocked key classifies inside the
+        brownout verdict set, never `unknown`, never `converged`."""
+        result = fuzz.run_scenario(
+            MINI_SEED, profile="mini", canary="slo-brownout", no_faults=True
+        )
+        explain_violations = [
+            v for v in result.violations if v.startswith("explain:")
+        ]
+        assert explain_violations == []
+
     def test_canary_gc_stale_owner_cache_is_caught(self):
         """Mutation run: a GC sweeper trusting a stale owner cache
         (grace disabled) reaps live owners — the live-owner deletion
