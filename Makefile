@@ -76,12 +76,18 @@ lint-invariants:
 # Whole-program analyses (agac_tpu/analysis/program.py): static
 # lock-order graph + inversion/bare-acquire detection, the
 # shared-mutable-state census (the multi-core refactor's work list),
-# and the determinism audit.  Gates on REGRESSIONS only: findings in
-# analysis_baseline.json are grandfathered with per-finding reasons;
-# a non-empty UNSAFE census bucket or a stale baseline entry fails.
+# the determinism audit, and the cross-process confinement analyzer
+# (per-stage footprint table + picklability/escape audits).  Gates on
+# REGRESSIONS only: findings in analysis_baseline.json are
+# grandfathered with per-finding reasons; a non-empty UNSAFE census
+# bucket, an unportable multi-core candidate stage, or a stale
+# baseline entry fails.  The `timeout` pins the whole-program wall
+# budget: all four analyses share one ParseCache (one parse per file),
+# so blowing 120 s means the single-parse invariant regressed, not
+# that the repo grew.
 .PHONY: lint-program
 lint-program:
-	$(PYTHON) -m agac_tpu.analysis.program agac_tpu --report analysis_report.json --baseline analysis_baseline.json
+	timeout 120 $(PYTHON) -m agac_tpu.analysis.program agac_tpu --report analysis_report.json --baseline analysis_baseline.json
 
 # Regenerate the metric catalog table in docs/operations.md from the
 # live registry (agac_tpu/observability/instruments.py declares every

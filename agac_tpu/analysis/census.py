@@ -178,6 +178,48 @@ def _class_has_lock(index: LockIndex, modname: str, cls: str) -> bool:
     )
 
 
+def _is_immutable_const(value: ast.expr) -> bool:
+    if isinstance(value, ast.Constant):
+        return True
+    if isinstance(value, ast.Tuple):
+        return all(_is_immutable_const(elt) for elt in value.elts)
+    return False
+
+
+def _class_is_stateless(minfo: ModuleInfo, cls: str) -> bool:
+    """True when a shared instance of ``cls`` is structurally immutable:
+    no bases (nothing inherited), empty ``__slots__`` (instance attrs
+    impossible), class-level assigns limited to immutable constants,
+    and no method writes ``self.X``.  Null-object singletons
+    (``_NULL_SCOPE`` / ``_NULL_STAGE``) earn ``confined`` this way —
+    safe to share across threads AND processes by construction."""
+    cinfo = minfo.classes.get(cls)
+    if cinfo is None or cinfo.node.bases or cinfo.node.keywords:
+        return False
+    has_empty_slots = False
+    for stmt in cinfo.node.body:
+        if isinstance(stmt, ast.Assign):
+            if not _is_immutable_const(stmt.value):
+                return False
+            for target in stmt.targets:
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id == "__slots__"
+                    and isinstance(stmt.value, ast.Tuple)
+                    and not stmt.value.elts
+                ):
+                    has_empty_slots = True
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None and not _is_immutable_const(stmt.value):
+                return False
+    if not has_empty_slots:
+        return False
+    for method in cinfo.methods.values():
+        if _self_attr_mutations(method):
+            return False
+    return True
+
+
 # ---------------------------------------------------------------------------
 # mutation scanning
 # ---------------------------------------------------------------------------
@@ -411,6 +453,14 @@ def build_census(program: Program) -> tuple[dict, list[Finding]]:
                     entry.bucket, entry.reason = (
                         "lock-guarded",
                         f"instance of internally locked {cls_ref[1]}",
+                    )
+                elif cls_ref is not None and _class_is_stateless(
+                    cls_ref[0], cls_ref[1]
+                ):
+                    entry.bucket, entry.reason = (
+                        "confined",
+                        f"stateless instance of {cls_ref[1]}: empty "
+                        "__slots__, immutable class attrs, no self-writes",
                     )
                 elif cls_ref is not None:
                     entry.bucket, entry.reason = (

@@ -556,7 +556,7 @@ class Baseline:
 # report + gate
 # ---------------------------------------------------------------------------
 
-REPORT_SCHEMA_VERSION = 1
+REPORT_SCHEMA_VERSION = 2
 
 
 def run_analyses(
@@ -586,6 +586,15 @@ def build_report(
         for entry in blocks.get("census", {}).get("census", [])
         if entry.get("bucket") == "UNSAFE"
     ]
+    # an unportable verdict on a roadmap-marked multi-core candidate
+    # stage gates exactly like an UNSAFE census entry: no baseline path
+    confinement_block = blocks.get("confinement", {})
+    unportable = [
+        {"stage": name, **info}
+        for name, info in sorted(confinement_block.get("stages", {}).items())
+        if info.get("verdict") == "unportable"
+        and name in confinement_block.get("multi_core_candidates", [])
+    ]
     return {
         "schema": REPORT_SCHEMA_VERSION,
         "generated_by": "agac_tpu.analysis.program",
@@ -593,6 +602,13 @@ def build_report(
         "parse": {
             "files": len(program.modules),
             "parses": sum(program.cache.parse_counts.values()),
+            # the single-parse invariant, inline: every path parsed more
+            # than once (a third-audit double-parse regression) is named
+            "reparsed": sorted(
+                path
+                for path, count in program.cache.parse_counts.items()
+                if count > 1
+            ),
         },
         "analyses": blocks,
         "findings": [f.to_json() for f in findings],
@@ -604,8 +620,9 @@ def build_report(
         "gate": {
             "new_findings": [f.to_json() for f in new],
             "unsafe_census": unsafe,
+            "unportable_stages": unportable,
             "stale_baseline": stale,
-            "clean": not new and not unsafe and not stale,
+            "clean": not new and not unsafe and not unportable and not stale,
         },
     }
 
@@ -625,6 +642,12 @@ def gate_failures(report: dict) -> list[str]:
             "UNSAFE — guard it with a lock, gate it behind a seam, or "
             "suppress inline with "
             "`# agac-lint: ignore[shared-state-census] -- reason`"
+        )
+    for entry in gate.get("unportable_stages", []):
+        failures.append(
+            f"[confinement] multi-core candidate stage {entry['stage']!r} is "
+            f"unportable: {entry['why']} — apply the discipline playbook "
+            "(lock-guard, seam-gate, or confine; docs/development.md)"
         )
     for key in gate["stale_baseline"]:
         failures.append(
@@ -646,7 +669,12 @@ def _load_analyses() -> list[ProgramRule]:
     ``__main__`` while the analyses register into the
     ``agac_tpu.analysis.program`` import of it — two distinct module
     objects, two ``PROGRAM_RULES`` lists."""
-    from agac_tpu.analysis import census, determinism, lockorder  # noqa: F401
+    from agac_tpu.analysis import (  # noqa: F401
+        census,
+        confinement,
+        determinism,
+        lockorder,
+    )
     from agac_tpu.analysis import program as canonical
 
     return canonical.PROGRAM_RULES

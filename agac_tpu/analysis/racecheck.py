@@ -69,6 +69,11 @@ class LockOrderWatchdog:
         self._edges: dict[tuple[str, str], _Edge] = {}
         self._violations: list[RaceViolation] = []
         self._tls = threading.local()
+        # (active profile-stage brackets, guarded-dict name) -> count:
+        # the runtime half of the confinement footprint cross-check
+        # (analysis/confinement.py); empty-stage mutations are skipped
+        # (no stage claims them, so the table has nothing to contradict)
+        self._stage_accesses: dict[tuple[tuple[str, ...], str], int] = {}
 
     # ---- per-thread held-lock stack -----------------------------------
     def _held(self) -> list:
@@ -132,6 +137,28 @@ class LockOrderWatchdog:
             if held[i] is lock:
                 del held[i]
                 return
+
+    def note_stage_access(self, name: str) -> None:
+        """Tag a guarded-dict mutation with the thread's open profile-
+        stage brackets.  Lazy import keeps this module import-light (the
+        core modules load racecheck at module scope); a missing or
+        stage-less profile module records nothing."""
+        try:
+            from ..observability import profile
+        except ImportError:  # pragma: no cover - stdlib-only envs
+            return
+        stages = profile.current_stages()
+        if not stages:
+            return
+        with self._mu:
+            key = (stages, name)
+            self._stage_accesses[key] = self._stage_accesses.get(key, 0) + 1
+
+    def stage_accesses(self) -> list[tuple[tuple[str, ...], str]]:
+        """Distinct (stage brackets, guarded-dict name) pairs observed —
+        the input ``confinement.runtime_footprint_crosscheck`` takes."""
+        with self._mu:
+            return sorted(self._stage_accesses)
 
     def note_unlocked_mutation(self, name: str, op: str) -> None:
         stack = "".join(traceback.format_stack(limit=16))
@@ -289,6 +316,7 @@ class GuardedDict(dict):
         self._watchdog = watchdog
 
     def _check(self, op: str) -> None:
+        self._watchdog.note_stage_access(self._name)
         if not self._lock.held_by_current_thread():
             self._watchdog.note_unlocked_mutation(self._name, op)
 

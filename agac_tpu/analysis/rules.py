@@ -100,6 +100,7 @@ to the offending line.  The justification is mandatory.
 from __future__ import annotations
 
 import ast
+import builtins
 import re
 import sys
 from dataclasses import dataclass, field
@@ -1231,6 +1232,179 @@ def check_unexplained_requeue(tree: ast.Module, ctx: LintContext) -> Iterator[Vi
             yield Violation(
                 "unexplained-requeue", str(ctx.path), node.lineno,
                 f"Result(...): {complaint}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# cross-boundary-capture
+# ---------------------------------------------------------------------------
+
+# receivers that look like executors; the submission methods that ship
+# a callable into them; Thread's target kwarg is the same boundary
+_POOLISH_RECEIVER = re.compile(r"(pool|executor)", re.IGNORECASE)
+_SUBMISSION_METHODS = frozenset({"submit", "map"})
+# analysis/ and sim/ are single-threaded offline tooling by contract
+# (the census's _SINGLE_THREADED); the parse cache's pool.map of a
+# bound method there is not a worker-runtime boundary
+_CAPTURE_EXEMPT_PARTS = frozenset({"analysis", "sim"})
+
+
+def _capture_rule_applies(ctx: LintContext) -> bool:
+    parts = set(ctx.path.parts)
+    return "agac_tpu" in parts and not (parts & _CAPTURE_EXEMPT_PARTS)
+
+
+def _module_scope_names(tree: ast.Module) -> set[str]:
+    """Names bound at module top level (defs, classes, imports, assigns)
+    — references to these from a nested def are not closure captures."""
+    names: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                names.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for target in targets:
+                if isinstance(target, ast.Name):
+                    names.add(target.id)
+    return names
+
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+def _free_names(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef, module_names: set[str]
+) -> list[str]:
+    """Names a nested def loads but binds neither locally nor at module
+    scope — the closure cells a process boundary cannot ship."""
+    args = fn.args
+    bound = {
+        a.arg
+        for a in (
+            *args.posonlyargs, *args.args, *args.kwonlyargs,
+            *((args.vararg,) if args.vararg else ()),
+            *((args.kwarg,) if args.kwarg else ()),
+        )
+    }
+    loaded: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                loaded.add(node.id)
+            else:
+                bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node is not fn:
+                bound.add(node.name)
+    return sorted(loaded - bound - module_names - _BUILTIN_NAMES)
+
+
+@rule(
+    "cross-boundary-capture",
+    "thread/executor submission sites may not capture enclosing state in "
+    "lambdas, bound methods, or closures — the multi-core executor swaps "
+    "these pools for process pools, and a capture that pickles by reference "
+    "(or drags a lock-holding instance along) fails exactly there",
+)
+def check_cross_boundary_capture(
+    tree: ast.Module, ctx: LintContext
+) -> Iterator[Violation]:
+    """The confinement analyzer (``analysis/confinement.py``) audits the
+    same boundary whole-program; this per-file rule catches the capture
+    at the PR diff, before the footprint table ever reruns.  One
+    inline ``# agac-lint: ignore[cross-boundary-capture] -- reason``
+    silences both (the analyzer honors the same comment)."""
+    if not _capture_rule_applies(ctx):
+        return
+    module_names = _module_scope_names(tree)
+    # innermost enclosing function of every call: ast.walk is BFS, so a
+    # nested def's pass over its own calls runs after (and overrides)
+    # every enclosing function's
+    enclosing_fn: dict[int, ast.FunctionDef] = {}
+    for fn_node in ast.walk(tree):
+        if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for node in ast.walk(fn_node):
+                if isinstance(node, ast.Call):
+                    enclosing_fn[id(node)] = fn_node
+
+    def describe(callable_expr: ast.expr, fn: Optional[ast.FunctionDef]) -> Optional[str]:
+        if isinstance(callable_expr, ast.Lambda):
+            return (
+                "a lambda — it pickles by reference, so a process-pool "
+                "submission cannot reconstruct it in the worker; pass a "
+                "module-level function (or partial over picklable args)"
+            )
+        if isinstance(callable_expr, ast.Attribute) and isinstance(
+            callable_expr.value, ast.Name
+        ) and callable_expr.value.id in ("self", "cls"):
+            return (
+                f"the bound method {callable_expr.value.id}."
+                f"{callable_expr.attr} — it drags the whole instance "
+                "(locks, sockets, caches and all) across the boundary"
+            )
+        if isinstance(callable_expr, ast.Name) and fn is not None:
+            # a def nested in the submitting function: flag only when it
+            # actually closes over enclosing state
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node is not fn
+                    and node.name == callable_expr.id
+                ):
+                    captured = _free_names(node, module_names)
+                    if captured:
+                        return (
+                            f"the nested function {callable_expr.id!r}, "
+                            "which closes over "
+                            f"{', '.join(repr(c) for c in captured[:4])} — "
+                            "closure cells cannot cross a process boundary"
+                        )
+                    return None
+        return None
+
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        callable_expr: Optional[ast.expr] = None
+        via = ""
+        if isinstance(func, ast.Attribute) and func.attr in _SUBMISSION_METHODS:
+            recv = func.value
+            recv_name = recv.id if isinstance(recv, ast.Name) else (
+                recv.attr if isinstance(recv, ast.Attribute) else None
+            )
+            if recv_name is None or not _POOLISH_RECEIVER.search(recv_name):
+                continue
+            callable_expr = node.args[0] if node.args else None
+            via = f"{recv_name}.{func.attr}"
+        elif (
+            isinstance(func, ast.Attribute) and func.attr == "Thread"
+        ) or (isinstance(func, ast.Name) and func.id == "Thread"):
+            target = next(
+                (k.value for k in node.keywords if k.arg == "target"), None
+            )
+            if not isinstance(target, ast.Lambda):
+                # nested-def / bound-method thread targets are the
+                # unseamed-thread analysis's jurisdiction; only the
+                # flat-out lambda is always a capture smell here
+                continue
+            callable_expr = target
+            via = "Thread(target=...)"
+        if callable_expr is None:
+            continue
+        complaint = describe(callable_expr, enclosing_fn.get(id(node)))
+        if complaint is not None:
+            yield Violation(
+                "cross-boundary-capture",
+                str(ctx.path),
+                node.lineno,
+                f"{via} ships {complaint}",
             )
 
 

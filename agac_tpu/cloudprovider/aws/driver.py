@@ -479,7 +479,7 @@ class AWSDriver:
             with ThreadPoolExecutor(max_workers=8) as pool:
                 for accelerator, tags in zip(
                     unknown,
-                    pool.map(
+                    pool.map(  # agac-lint: ignore[cross-boundary-capture] -- in-process ThreadPoolExecutor gated on threads_enabled(); the multi-core executor replaces this whole cold-fill, not its pool
                         lambda a: self.ga.list_tags_for_resource(
                             a.accelerator_arn
                         ),

@@ -735,11 +735,17 @@ def start_worker_watchdog(
     heartbeats: Optional[WorkerHeartbeats] = None,
     interval: float = 30.0,
     threshold: float = 300.0,
-) -> threading.Thread:
+) -> Optional[threading.Thread]:
     """Daemon that periodically surfaces workers stuck on one item
     past ``threshold`` seconds (a wedged settle poll, a hung call):
     the log line names the worker and the reconcile key so the wedge
-    is diagnosable while it is happening, not from a post-mortem."""
+    is diagnosable while it is happening, not from a post-mortem.
+
+    Under the sim's cooperative executor (``threads_enabled()`` false)
+    this starts nothing and returns None — the sim owns every
+    interleaving, and a wild watchdog thread would race virtual time."""
+    if not clockseam.threads_enabled():
+        return None
     table = heartbeats or worker_heartbeats()
 
     def loop():

@@ -138,6 +138,12 @@ class SharedInformer:
     # ---- run loops -----------------------------------------------------
     def run(self, stop: threading.Event) -> None:
         """Start the watch and dispatch threads; returns immediately."""
+        if not clockseam.threads_enabled():
+            raise RuntimeError(
+                "SharedInformer.run spawns watch/dispatch threads; under "
+                "the sim's cooperative executor drive the informer with "
+                "explicit relist/dispatch steps instead"
+            )
         with self._lock:
             if self._started:
                 return

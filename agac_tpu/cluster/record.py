@@ -189,6 +189,13 @@ class EventRecorder:
     # persistence worker (all I/O happens here, never under the lock)
     # ------------------------------------------------------------------
     def _ensure_worker(self) -> None:
+        # under a disabled seam the recorder must never spawn, even if
+        # it was constructed synchronous=False before a sim flipped the
+        # seam: events stay queued for the next explicit flush().  (No
+        # inline drain HERE — this runs under self._lock and
+        # _drain_step re-acquires it.)
+        if not clockseam.threads_enabled():
+            return
         if self._worker is None or not self._worker.is_alive():
             self._stopped = False
             self._worker = threading.Thread(

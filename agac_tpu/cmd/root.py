@@ -399,6 +399,15 @@ def resolve_kubeconfig(flag_value: str) -> str:
 
 
 def run_controller(args) -> int:
+    from .. import clockseam
+
+    if not clockseam.threads_enabled():
+        # the CLI lifecycle spawns slo/autoscale/health-server threads;
+        # it is the production entry point and has no sim analogue
+        raise RuntimeError(
+            "run_controller requires a threaded runtime "
+            "(clockseam.threads_enabled() is false)"
+        )
     from ..cluster.rest import build_client
     from ..controllers import (
         EndpointGroupBindingConfig,

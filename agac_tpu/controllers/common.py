@@ -190,6 +190,11 @@ def run_workers(
     worker-spec shape for the explain plane's not-managed verdict; the
     worker loop itself never consults it."""
     del managed
+    if not clockseam.threads_enabled():
+        raise RuntimeError(
+            "run_workers spawns worker threads; under the sim's "
+            "cooperative executor step worker_specs() explicitly"
+        )
     process_delete = with_circuit_backoff(process_delete)
     process_create_or_update = with_circuit_backoff(process_create_or_update)
 
@@ -234,6 +239,10 @@ def start_drift_resync(
     triggered reconcile of a converged item, ~4 AWS reads with the
     discovery cache warm (docs/operations.md "Steady-state cost")."""
     if period <= 0:
+        return None
+    if not clockseam.threads_enabled():
+        # same contract as period=0: returns None and starts nothing —
+        # sims drive drift verification by stepping tickers themselves
         return None
 
     def loop():

@@ -134,6 +134,12 @@ class LeaderElection:
         lease renewed in the background; if renewal fails past the
         renew deadline, fire ``on_stopped_leading`` (process exit in
         the CLI) and set ``stop``."""
+        if not clockseam.threads_enabled():
+            raise RuntimeError(
+                "LeaderElection.run spawns the lease-renew thread; under "
+                "the sim's cooperative executor use a _SimElector actor "
+                "with set_leading() instead"
+            )
         klog.infof("leader election id: %s", self.identity)
         last_reported_leader = ""
         while not stop.is_set():

@@ -38,7 +38,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
-from . import klog
+from . import clockseam, klog
 from .cloudprovider.aws import health as api_health
 from .cluster import ClusterClient, SharedInformerFactory
 from .observability import fleet as obs_fleet
@@ -277,6 +277,12 @@ class Manager:
         """Start every registered controller plus the shared informers;
         with ``block=True`` (the reference's ``wg.Wait()``) returns only
         after ``stop`` fires and all controller threads exit."""
+        if not clockseam.threads_enabled():
+            raise RuntimeError(
+                "Manager.run spawns controller/gc/shard threads; under "
+                "the sim's cooperative executor call build() and step "
+                "the worker specs explicitly"
+            )
         informer_factory = self.build(client, config, cloud_factory)
         # the threaded (production) lifecycle owns the process: its
         # engine becomes the global one the reconcile loop's recorder

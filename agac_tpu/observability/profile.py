@@ -156,6 +156,11 @@ class _Scope:
 
 
 class _NullScope:
+    # no instance state: the singleton is shared across every thread,
+    # and empty __slots__ makes that structurally true (the confinement
+    # census proves it stateless rather than trusting the comment)
+    __slots__ = ()
+
     controller = ""
 
     def breakdown_us(self) -> dict[str, int]:
@@ -280,6 +285,25 @@ def current_scope():
     breakdown from."""
     scope = getattr(_tls, "scope", None)
     return scope if scope is not None else _NULL_SCOPE
+
+
+def current_stages() -> tuple[str, ...]:
+    """Names of the thread's open stage brackets, outermost first
+    (``("driver-mutate", "aws:ga.CreateAccelerator")`` inside an
+    instrumented AWS call).  The runtime side of the confinement
+    cross-check: racecheck tags observed shared-state mutations with
+    this tuple so they can be matched against the static stage
+    footprint table."""
+    stack = getattr(_tls, "frames", None)
+    if not stack:
+        return ()
+    return tuple(frame.name for frame in stack)
+
+
+def current_stage() -> Optional[str]:
+    """The innermost open stage bracket, or None outside any."""
+    stack = getattr(_tls, "frames", None)
+    return stack[-1].name if stack else None
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,7 @@ import threading
 from typing import Callable, Dict, Optional
 
 from .. import clockseam, klog
+from ..analysis import racecheck
 
 DEFAULT_HZ = 97.0  # prime-ish: avoids phase-locking with 10ms tickers
 MAX_STACK_DEPTH = 64
@@ -131,6 +132,12 @@ class StackProfiler:
         sleep: Callable[[float], None] = clockseam.sleep,
         max_depth: int = MAX_STACK_DEPTH,
     ):
+        # guards hz and the continuous-sampler thread handle: the
+        # process-global profiler is shared (configure() from cmd/root,
+        # start() from the manager, capture() from health handlers), so
+        # its mutable fields take the racecheck-visible lock — the
+        # shared-state census classifies `_profiler` off this site
+        self._mu = racecheck.make_lock("stackprof")
         self.hz = max(1.0, float(hz))
         self._frames_fn = frames_fn or sys._current_frames
         self._clock = clock
@@ -190,13 +197,26 @@ class StackProfiler:
         fallback)."""
         if not clockseam.threads_enabled():
             return None
-        if self._thread is not None and self._thread.is_alive():
-            return self._thread
-        self._thread = threading.Thread(
-            target=self.run, args=(stop,), daemon=True, name="stack-profiler"
-        )
-        self._thread.start()
-        return self._thread
+        with self._mu:
+            existing = self._thread
+            # ident is None while created-but-unstarted: a concurrent
+            # starter must piggyback on it, not double-spawn
+            if existing is not None and (
+                existing.ident is None or existing.is_alive()
+            ):
+                return existing
+            thread = threading.Thread(
+                target=self.run, args=(stop,), daemon=True, name="stack-profiler"
+            )
+            self._thread = thread
+        # started outside the lock: .start() never runs under _mu, so
+        # the profiler's lock stays a leaf in the static lock order
+        thread.start()
+        return thread
+
+    def set_rate(self, hz: float) -> None:
+        with self._mu:
+            self.hz = max(1.0, float(hz))
 
     def log_top(self, n: int = 10) -> None:
         """Dump the continuous aggregate's top table via klog — the
@@ -227,7 +247,7 @@ def profiler() -> StackProfiler:
 
 def configure(hz: Optional[float] = None) -> None:
     if hz is not None and hz > 0:
-        _profiler.hz = float(hz)
+        _profiler.set_rate(hz)
 
 
 def capture(seconds: float, hz: Optional[float] = None) -> dict:

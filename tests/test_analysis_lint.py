@@ -1294,10 +1294,163 @@ class TestUnattributedStage:
         assert _STAGE_NAMES == frozenset(profile.STAGES)
 
 
+# ---------------------------------------------------------------------------
+# cross-boundary-capture
+# ---------------------------------------------------------------------------
+
+
+class TestCrossBoundaryCapture:
+    def test_lambda_submission_fires_once(self):
+        v = only(
+            run(
+                """
+                def fan_out(pool, items):
+                    return [pool.submit(lambda: item) for item in items]
+                """,
+                path="agac_tpu/cloudprovider/aws/bad.py",
+            ),
+            "cross-boundary-capture",
+        )
+        assert "lambda" in v.message and "pool.submit" in v.message
+
+    def test_bound_method_submission_fires_once(self):
+        v = only(
+            run(
+                """
+                class Batcher:
+                    def kick(self, executor):
+                        return executor.submit(self.flush)
+
+                    def flush(self):
+                        return None
+                """,
+                path="agac_tpu/cloudprovider/aws/bad.py",
+            ),
+            "cross-boundary-capture",
+        )
+        assert "self.flush" in v.message
+
+    def test_nested_def_with_captures_fires_once(self):
+        v = only(
+            run(
+                """
+                def fan_out(pool, items):
+                    def work():
+                        return items
+                    return pool.submit(work)
+                """,
+                path="agac_tpu/cloudprovider/aws/bad.py",
+            ),
+            "cross-boundary-capture",
+        )
+        assert "'items'" in v.message
+
+    def test_capture_free_nested_def_is_clean(self):
+        # binds everything it loads: nothing to pickle by reference
+        assert (
+            run(
+                """
+                def fan_out(pool):
+                    def work():
+                        out = 1
+                        return out
+                    return pool.submit(work)
+                """,
+                path="agac_tpu/cloudprovider/aws/good.py",
+            )
+            == []
+        )
+
+    def test_module_level_function_is_clean(self):
+        assert (
+            run(
+                """
+                def work(item):
+                    return item
+
+
+                def fan_out(pool, items):
+                    return pool.map(work, items)
+                """,
+                path="agac_tpu/cloudprovider/aws/good.py",
+            )
+            == []
+        )
+
+    def test_thread_target_lambda_fires_once(self):
+        v = only(
+            run(
+                """
+                import threading
+
+
+                def kick():
+                    threading.Thread(target=lambda: None).start()
+                """,
+                path="agac_tpu/cluster/bad.py",
+            ),
+            "cross-boundary-capture",
+        )
+        assert "Thread(target=...)" in v.message
+
+    def test_thread_target_named_function_is_other_rules_jurisdiction(self):
+        # nested-def / bound-method thread targets belong to the
+        # unseamed-thread whole-program analysis, not this rule
+        assert (
+            run(
+                """
+                import threading
+
+
+                def kick(run):
+                    threading.Thread(target=run).start()
+                """,
+                path="agac_tpu/cluster/good.py",
+            )
+            == []
+        )
+
+    def test_non_poolish_receiver_is_clean(self):
+        assert (
+            run(
+                """
+                def render(canvas, items):
+                    return canvas.map(lambda i: i, items)
+                """,
+                path="agac_tpu/controllers/good.py",
+            )
+            == []
+        )
+
+    def test_suppression_with_justification(self):
+        src = """
+            def fan_out(pool, items):
+                return pool.submit(lambda: items)  # agac-lint: ignore[cross-boundary-capture] -- in-process pool behind the seam
+        """
+        assert run(src, path="agac_tpu/cloudprovider/aws/bad.py") == []
+
+    def test_suppression_without_justification_is_rejected(self):
+        src = """
+            def fan_out(pool, items):
+                return pool.submit(lambda: items)  # agac-lint: ignore[cross-boundary-capture]
+        """
+        violations = run(src, path="agac_tpu/cloudprovider/aws/bad.py")
+        assert violations, "bare suppression must not silence the rule"
+
+    def test_analysis_and_sim_are_exempt(self):
+        src = """
+            def fan_out(pool, items):
+                return [pool.submit(lambda: item) for item in items]
+        """
+        assert run(src, path="agac_tpu/analysis/tooling.py") == []
+        assert run(src, path="agac_tpu/sim/executor.py") == []
+
+
 def test_rule_registry_ships_the_documented_rules():
     ids = {r.id for r in RULES}
     assert ids == {
         "raw-backend-call",
+        "cross-boundary-capture",
         "bare-lock-acquire",
         "blocking-reconcile",
         "reconcile-returns-result",

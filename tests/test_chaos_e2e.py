@@ -31,7 +31,7 @@ import urllib.request
 import pytest
 
 from agac_tpu import apis
-from agac_tpu.analysis import lockorder, racecheck
+from agac_tpu.analysis import confinement, lockorder, racecheck
 from agac_tpu.cloudprovider.aws import AWSDriver
 from agac_tpu.cloudprovider.aws.fake_backend import FakeAWSBackend
 from agac_tpu.cloudprovider.aws.health import (
@@ -124,6 +124,13 @@ def _racecheck_watchdog():
         # whole-program analysis has a call-graph blind spot
         violations, _ = lockorder.runtime_crosscheck(watchdog.edges())
         assert not violations, "\n".join(violations)
+        # stage-tagged shared-state writes must land inside some active
+        # stage's static footprint (ISSUE 16) — chaos drives the retry
+        # paths where an undeclared write would first show up
+        fp_violations, _ = confinement.runtime_footprint_crosscheck(
+            watchdog.stage_accesses()
+        )
+        assert not fp_violations, "\n".join(fp_violations)
     finally:
         racecheck.disable()
 

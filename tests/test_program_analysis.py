@@ -17,7 +17,7 @@ import textwrap
 
 import pytest
 
-from agac_tpu.analysis import census, determinism, lockorder  # noqa: F401  (registers rules)
+from agac_tpu.analysis import census, confinement, determinism, lockorder  # noqa: F401  (registers rules)
 from agac_tpu.analysis.lint import lint_paths
 from agac_tpu.analysis.program import (
     Baseline,
@@ -302,21 +302,30 @@ class TestReportSchema:
         program = build_fixture(tmp_path, {"pair.py": INVERSION_SRC})
         findings, blocks = run_analyses(program)
         report = build_report(program, findings, blocks, Baseline())
-        assert report["schema"] == 1
+        assert report["schema"] == 2
         assert set(report) == {
             "schema", "generated_by", "modules", "parse",
             "analyses", "findings", "baseline", "gate",
         }
+        assert set(report["parse"]) >= {"files", "parses", "reparsed"}
+        assert report["parse"]["reparsed"] == []
         assert set(report["gate"]) == {
-            "new_findings", "unsafe_census", "stale_baseline", "clean",
+            "new_findings", "unsafe_census", "unportable_stages",
+            "stale_baseline", "clean",
         }
         assert set(report["baseline"]) == {"entries", "grandfathered", "stale"}
-        assert set(report["analyses"]) == {"lock-order", "census", "determinism"}
+        assert set(report["analyses"]) == {
+            "lock-order", "census", "determinism", "confinement",
+        }
         assert set(report["analyses"]["lock-order"]) == {
             "locks", "identities", "edges", "findings",
         }
         assert set(report["analyses"]["census"]) == {
             "census", "buckets", "thread_roots",
+        }
+        assert set(report["analyses"]["confinement"]) == {
+            "stages", "multi_core_candidates", "worker_scope",
+            "unseamed_spawners", "picklability", "escapes",
         }
         for f in report["findings"]:
             assert set(f) == {"analysis", "rule", "path", "line", "key", "message"}

@@ -28,7 +28,7 @@ import time
 import pytest
 
 from agac_tpu import apis
-from agac_tpu.analysis import lockorder, racecheck
+from agac_tpu.analysis import confinement, lockorder, racecheck
 from agac_tpu.cloudprovider.aws import AWSDriver, FakeAWSBackend
 from agac_tpu.cluster import FakeCluster
 from agac_tpu.manager import ControllerConfig, Manager
@@ -69,6 +69,14 @@ def _racecheck_watchdog():
         # call-graph blind spot in the whole-program analysis
         violations, _ = lockorder.runtime_crosscheck(watchdog.edges())
         assert not violations, "\n".join(violations)
+        # ...and every stage-tagged shared-state write must land inside
+        # some active stage's statically declared footprint (ISSUE 16):
+        # an observed write the table doesn't cover means the multi-core
+        # dispatch plan has a call-graph blind spot
+        fp_violations, _ = confinement.runtime_footprint_crosscheck(
+            watchdog.stage_accesses()
+        )
+        assert not fp_violations, "\n".join(fp_violations)
     finally:
         racecheck.disable()
 
