@@ -323,15 +323,18 @@ class Program:
     # names so ubiquitous that a by-name fallback match would wire most
     # of the program together and drown every path-sensitive analysis
     _FALLBACK_CAP = 12
-    # collection-protocol names: `d.get()` / `s.add()` on a plain dict
-    # or set would otherwise fallback-match every program method of the
-    # same name, wiring unrelated lock scopes together
+    # collection- and io-protocol names: `d.get()` / `s.add()` on a
+    # plain dict or set — or `f.write()` / `f.flush()` / `f.close()`
+    # on a file handle — would otherwise fallback-match every program
+    # method of the same name, wiring unrelated lock scopes together
+    # (the incident capture's `self._file.flush()` under its ring lock
+    # must not resolve to EventRecorder.flush)
     _FALLBACK_DENY = frozenset(
         {
             "get", "add", "pop", "update", "clear", "append", "remove",
             "discard", "extend", "insert", "setdefault", "popitem",
             "keys", "values", "items", "copy", "sort", "index", "count",
-            "put",
+            "put", "write", "flush", "close",
         }
     )
 

@@ -93,6 +93,14 @@ stack — the classes ruff's pyflakes-tier cannot express:
   unexplained (or computed) movement is a key ``/debug/explain`` can
   only shrug at — exactly the ``unknown`` verdict the catalog forbids.
 
+- ``untapped-external-input`` — the seams where external inputs enter
+  the process (informer event delivery via ``apply_event``, AWS call
+  outcome classification via ``record_call``, signal registration via
+  ``signal.signal``) must route through the incident-capture tap
+  (``sim/capture.py``, ISSUE 19): the replay tape is only as complete
+  as its taps, so an input consumed past the tap turns every captured
+  incident into an unexplained divergence at replay time.
+
 Suppression: append ``# agac-lint: ignore[rule-id] -- justification``
 to the offending line.  The justification is mandatory.
 """
@@ -1405,6 +1413,107 @@ def check_cross_boundary_capture(
                 str(ctx.path),
                 node.lineno,
                 f"{via} ships {complaint}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# untapped-external-input
+# ---------------------------------------------------------------------------
+
+# The seams where external inputs enter the process, and the tap
+# methods (sim/capture.py) that must see them.  An input consumed
+# past the tap is a hole in the incident tape: a captured run whose
+# replay can only discover the miss as an unexplained divergence.
+# The anchor is the consuming call; the discharge is any reference to
+# the matching tap surface in the same function (nested defs count —
+# the handler closure in setup_signal_handler is the canonical shape).
+_EXTERNAL_INPUT_SEAMS: tuple[tuple[str, tuple[str, ...], str], ...] = (
+    (
+        "apply_event",
+        ("record_informer_batch", "record_informer", "informer_feed"),
+        "informer event delivery",
+    ),
+    (
+        "record_call",
+        ("record_aws_call",),
+        "AWS call outcome classification",
+    ),
+    (
+        "signal",
+        ("record_signal",),
+        "signal handler registration",
+    ),
+)
+
+# the tap's own module (and the replay driving it) discharge by being
+# the capture plane
+_UNTAPPED_EXEMPT_FILES = frozenset({"capture.py", "replay.py"})
+
+
+def _untapped_rule_applies(ctx: LintContext) -> bool:
+    parts = set(ctx.path.parts)
+    return "agac_tpu" in parts and ctx.path.name not in _UNTAPPED_EXEMPT_FILES
+
+
+def _referenced_names(fn: ast.AST) -> set[str]:
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.add(node.attr)
+    return names
+
+
+@rule(
+    "untapped-external-input",
+    "external-input seams (informer event delivery, AWS outcome "
+    "classification, signal registration) must route through the "
+    "incident-capture tap — an input the tape never sees makes every "
+    "capture of that run unreplayable",
+)
+def check_untapped_external_input(
+    tree: ast.Module, ctx: LintContext
+) -> Iterator[Violation]:
+    if not _untapped_rule_applies(ctx):
+        return
+    # innermost enclosing function per call (BFS walk: nested defs
+    # override their enclosers), so the discharge scope is the whole
+    # consuming function including its nested handlers
+    top_fn: dict[int, ast.AST] = {}
+    for fn_node in ast.walk(tree):
+        if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for node in ast.walk(fn_node):
+                if isinstance(node, ast.Call) and id(node) not in top_fn:
+                    top_fn[id(node)] = fn_node
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        for anchor, taps, what in _EXTERNAL_INPUT_SEAMS:
+            if func.attr != anchor:
+                continue
+            if anchor == "signal":
+                # only the stdlib registration call, not arbitrary
+                # .signal() methods
+                recv = func.value
+                if not (isinstance(recv, ast.Name) and recv.id == "signal"):
+                    continue
+            fn = top_fn.get(id(node))
+            scope = fn if fn is not None else tree
+            referenced = _referenced_names(scope)
+            if referenced & set(taps) or "capture" in referenced:
+                continue
+            yield Violation(
+                "untapped-external-input",
+                str(ctx.path),
+                node.lineno,
+                f"{what} ({func.attr}) consumed without feeding the "
+                f"incident-capture tap; call {taps[0]} (or route through "
+                "the installed capture) so a recorded run can replay "
+                "this input",
             )
 
 

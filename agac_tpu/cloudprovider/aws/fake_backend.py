@@ -696,7 +696,13 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
                     ERR_LIMIT_EXCEEDED,
                     f"account quota of {self.quota_accelerators} accelerators reached",
                 )
-            arn = f"arn:aws:globalaccelerator::{_ACCOUNT}:accelerator/{uuid.uuid4()}"
+            # uuid5 over the serial counter, not uuid4: the ARN must be
+            # re-derivable on incident replay (counter state travels in
+            # the capture snapshot; random minting would diverge)
+            arn = (
+                f"arn:aws:globalaccelerator::{_ACCOUNT}:accelerator/"
+                f"{uuid.uuid5(uuid.NAMESPACE_URL, f'agac/{_ACCOUNT}/{next(self._counter)}')}"
+            )
             accelerator = Accelerator(
                 accelerator_arn=arn,
                 name=name,
@@ -1136,6 +1142,142 @@ class FakeAWSBackend(GlobalAcceleratorAPI, ELBv2API, Route53API):
                     table[key] = record
             self.calls.append(("ChangeResourceRecordSets", hosted_zone_id))
 
+    # -- serialization ---------------------------------------------------
+    def _encode(self) -> dict:
+        """The complete service state as JSON-able primitives (caller
+        holds ``self._lock``)."""
+
+        def encode_rrs(r: ResourceRecordSet) -> dict:
+            return {
+                "name": r.name,
+                "type": r.type,
+                "ttl": r.ttl,
+                "values": [rr.value for rr in r.resource_records],
+                "alias": dict(vars(r.alias_target)) if r.alias_target else None,
+            }
+
+        return {
+            "counter": self._counter.value,
+            "accelerators": [
+                {
+                    "accelerator": dict(vars(state.accelerator)),
+                    "tags": [[t.key, t.value] for t in state.tags],
+                    "pending_describes": state.pending_describes,
+                    "listeners": [
+                        {
+                            "listener_arn": listener.listener_arn,
+                            "protocol": listener.protocol,
+                            "client_affinity": listener.client_affinity,
+                            "port_ranges": [
+                                [p.from_port, p.to_port] for p in listener.port_ranges
+                            ],
+                        }
+                        for listener in state.listeners.values()
+                    ],
+                }
+                for state in self._accelerators.values()
+            ],
+            "endpoint_groups": [
+                {
+                    "endpoint_group_arn": eg.endpoint_group_arn,
+                    "region": eg.endpoint_group_region,
+                    "parent": self._eg_parent[arn],
+                    "endpoints": [dict(vars(d)) for d in eg.endpoint_descriptions],
+                }
+                for arn, eg in self._endpoint_groups.items()
+            ],
+            "load_balancers": [dict(vars(lb)) for lb in self._load_balancers.values()],
+            "zones": [dict(vars(z)) for z in self._zones.values()],
+            "records": {
+                zone_id: [encode_rrs(r) for r in table.values()]
+                for zone_id, table in self._records.items()
+            },
+        }
+
+    def _apply_state(self, data: dict) -> None:
+        """Replace in-memory state with ``data`` (caller holds
+        ``self._lock``).  The guarded dicts are mutated in place so the
+        racecheck instrumentation survives the reload."""
+        from .types import AliasTarget, ResourceRecord
+
+        self._counter.value = max(self._counter.value, int(data.get("counter", 1)))
+        self._accelerators.clear()
+        self._listener_parent.clear()
+        self._settling.clear()
+        self._egs_by_listener.clear()
+        self._accel_list_cache = None
+        for entry in data.get("accelerators", []):
+            accelerator = Accelerator(**entry["accelerator"])
+            state = _AcceleratorState(
+                accelerator,
+                [Tag(k, v) for k, v in entry["tags"]],
+                int(entry.get("pending_describes", 0)),
+            )
+            for ldata in entry.get("listeners", []):
+                listener = Listener(
+                    listener_arn=ldata["listener_arn"],
+                    protocol=ldata["protocol"],
+                    client_affinity=ldata["client_affinity"],
+                    port_ranges=[PortRange(f, t) for f, t in ldata["port_ranges"]],
+                )
+                state.listeners[listener.listener_arn] = listener
+                self._listener_parent[listener.listener_arn] = (
+                    accelerator.accelerator_arn
+                )
+            self._accelerators[accelerator.accelerator_arn] = state
+            if state.pending_describes > 0:
+                self._settling[accelerator.accelerator_arn] = None
+        self._endpoint_groups.clear()
+        self._eg_parent.clear()
+        for entry in data.get("endpoint_groups", []):
+            eg = EndpointGroup(
+                endpoint_group_arn=entry["endpoint_group_arn"],
+                endpoint_group_region=entry["region"],
+                endpoint_descriptions=[
+                    EndpointDescription(**d) for d in entry.get("endpoints", [])
+                ],
+            )
+            self._endpoint_groups[eg.endpoint_group_arn] = eg
+            self._eg_parent[eg.endpoint_group_arn] = entry["parent"]
+            self._egs_by_listener.setdefault(entry["parent"], {})[
+                eg.endpoint_group_arn
+            ] = None
+        self._load_balancers.clear()
+        for entry in data.get("load_balancers", []):
+            lb = LoadBalancer(**entry)
+            self._load_balancers[lb.load_balancer_name] = lb
+        self._zones.clear()
+        self._records.clear()
+        for entry in data.get("zones", []):
+            zone = HostedZone(**entry)
+            self._zones[zone.id] = zone
+            self._records[zone.id] = {}
+        for zone_id, records in data.get("records", {}).items():
+            table = self._records.setdefault(zone_id, {})
+            for rdata in records:
+                record = ResourceRecordSet(
+                    name=rdata["name"],
+                    type=rdata["type"],
+                    ttl=rdata["ttl"],
+                    resource_records=[ResourceRecord(v) for v in rdata["values"]],
+                    alias_target=(
+                        AliasTarget(**rdata["alias"]) if rdata["alias"] else None
+                    ),
+                )
+                table[(record.name, record.type)] = record
+
+    def snapshot_state(self) -> dict:
+        """The full service state, JSON-able — the incident capture's
+        AWS seed (ISSUE 19): a replay restores it verbatim before
+        re-deriving the recorded call stream."""
+        with self._lock:
+            return self._encode()
+
+    def restore_state(self, data: dict) -> None:
+        """Replace all service state with a ``snapshot_state()`` dump."""
+        with self._lock:
+            self._apply_state(data)
+
 
 class FileBackedFakeAWSBackend(FakeAWSBackend):
     """Durable fake AWS: committed state survives process death.
@@ -1298,130 +1440,6 @@ class FileBackedFakeAWSBackend(FakeAWSBackend):
                 if zone.name == name:
                     return zone.id
         return None
-
-    # -- serialization ---------------------------------------------------
-    def _encode(self) -> dict:
-        """The complete service state as JSON-able primitives (caller
-        holds ``self._lock``)."""
-
-        def encode_rrs(r: ResourceRecordSet) -> dict:
-            return {
-                "name": r.name,
-                "type": r.type,
-                "ttl": r.ttl,
-                "values": [rr.value for rr in r.resource_records],
-                "alias": dict(vars(r.alias_target)) if r.alias_target else None,
-            }
-
-        return {
-            "counter": self._counter.value,
-            "accelerators": [
-                {
-                    "accelerator": dict(vars(state.accelerator)),
-                    "tags": [[t.key, t.value] for t in state.tags],
-                    "pending_describes": state.pending_describes,
-                    "listeners": [
-                        {
-                            "listener_arn": listener.listener_arn,
-                            "protocol": listener.protocol,
-                            "client_affinity": listener.client_affinity,
-                            "port_ranges": [
-                                [p.from_port, p.to_port] for p in listener.port_ranges
-                            ],
-                        }
-                        for listener in state.listeners.values()
-                    ],
-                }
-                for state in self._accelerators.values()
-            ],
-            "endpoint_groups": [
-                {
-                    "endpoint_group_arn": eg.endpoint_group_arn,
-                    "region": eg.endpoint_group_region,
-                    "parent": self._eg_parent[arn],
-                    "endpoints": [dict(vars(d)) for d in eg.endpoint_descriptions],
-                }
-                for arn, eg in self._endpoint_groups.items()
-            ],
-            "load_balancers": [dict(vars(lb)) for lb in self._load_balancers.values()],
-            "zones": [dict(vars(z)) for z in self._zones.values()],
-            "records": {
-                zone_id: [encode_rrs(r) for r in table.values()]
-                for zone_id, table in self._records.items()
-            },
-        }
-
-    def _apply_state(self, data: dict) -> None:
-        """Replace in-memory state with ``data`` (caller holds
-        ``self._lock``).  The guarded dicts are mutated in place so the
-        racecheck instrumentation survives the reload."""
-        from .types import AliasTarget, ResourceRecord
-
-        self._counter.value = max(self._counter.value, int(data.get("counter", 1)))
-        self._accelerators.clear()
-        self._listener_parent.clear()
-        self._settling.clear()
-        self._egs_by_listener.clear()
-        self._accel_list_cache = None
-        for entry in data.get("accelerators", []):
-            accelerator = Accelerator(**entry["accelerator"])
-            state = _AcceleratorState(
-                accelerator,
-                [Tag(k, v) for k, v in entry["tags"]],
-                int(entry.get("pending_describes", 0)),
-            )
-            for ldata in entry.get("listeners", []):
-                listener = Listener(
-                    listener_arn=ldata["listener_arn"],
-                    protocol=ldata["protocol"],
-                    client_affinity=ldata["client_affinity"],
-                    port_ranges=[PortRange(f, t) for f, t in ldata["port_ranges"]],
-                )
-                state.listeners[listener.listener_arn] = listener
-                self._listener_parent[listener.listener_arn] = (
-                    accelerator.accelerator_arn
-                )
-            self._accelerators[accelerator.accelerator_arn] = state
-            if state.pending_describes > 0:
-                self._settling[accelerator.accelerator_arn] = None
-        self._endpoint_groups.clear()
-        self._eg_parent.clear()
-        for entry in data.get("endpoint_groups", []):
-            eg = EndpointGroup(
-                endpoint_group_arn=entry["endpoint_group_arn"],
-                endpoint_group_region=entry["region"],
-                endpoint_descriptions=[
-                    EndpointDescription(**d) for d in entry.get("endpoints", [])
-                ],
-            )
-            self._endpoint_groups[eg.endpoint_group_arn] = eg
-            self._eg_parent[eg.endpoint_group_arn] = entry["parent"]
-            self._egs_by_listener.setdefault(entry["parent"], {})[
-                eg.endpoint_group_arn
-            ] = None
-        self._load_balancers.clear()
-        for entry in data.get("load_balancers", []):
-            lb = LoadBalancer(**entry)
-            self._load_balancers[lb.load_balancer_name] = lb
-        self._zones.clear()
-        self._records.clear()
-        for entry in data.get("zones", []):
-            zone = HostedZone(**entry)
-            self._zones[zone.id] = zone
-            self._records[zone.id] = {}
-        for zone_id, records in data.get("records", {}).items():
-            table = self._records.setdefault(zone_id, {})
-            for rdata in records:
-                record = ResourceRecordSet(
-                    name=rdata["name"],
-                    type=rdata["type"],
-                    ttl=rdata["ttl"],
-                    resource_records=[ResourceRecord(v) for v in rdata["values"]],
-                    alias_target=(
-                        AliasTarget(**rdata["alias"]) if rdata["alias"] else None
-                    ),
-                )
-                table[(record.name, record.type)] = record
 
     # -- the file ---------------------------------------------------------
     def _stat_stamp(self) -> Optional[tuple]:

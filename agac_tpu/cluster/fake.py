@@ -184,6 +184,32 @@ class FakeCluster(ClusterClient):
                 del store[key]
                 self._broadcast(kind, "DELETED", obj, rv)
 
+    # ---- incident capture & replay (ISSUE 19) -------------------------
+    def snapshot(self) -> tuple[list[tuple[str, Any]], int]:
+        """Point-in-time copy of the store for a capture header:
+        ``(kind, object)`` pairs ordered by resourceVersion (so a
+        restore re-seeds in creation order) plus the rv counter."""
+        with self._lock:
+            objects = [
+                (kind, copy.deepcopy(obj))
+                for kind, store in self._store.items()
+                for obj in store.values()
+            ]
+            objects.sort(key=lambda item: int(item[1].metadata.resource_version or 0))
+            return objects, self._rv
+
+    def restore(self, objects: list[tuple[str, Any]], resource_version: int) -> None:
+        """Seed this (fresh) cluster from a capture-header snapshot:
+        objects land verbatim — same uid/rv/generation, NO watch events
+        — and the rv counter resumes where the recording's stood, so a
+        replayed run mints the same resourceVersion stream the live
+        run did."""
+        with self._lock:
+            for kind, obj in objects:
+                key = meta_namespace_key(obj)
+                self._kind_store(kind)[key] = copy.deepcopy(obj)
+            self._rv = max(self._rv, int(resource_version))
+
     def events_since(
         self, kind: str, resource_version: str
     ) -> tuple[Optional[list[WatchEvent]], str]:

@@ -160,14 +160,34 @@ class SharedInformer:
             try:
                 rv = self._relist()
                 self._synced.set()
+                self._record_arrival(rv, (), relist=True)
                 deadline = self._clock() + self._resync_period
                 should_stop = lambda: stop.is_set() or self._clock() >= deadline
                 for event in self._client.watch(self.kind, rv, should_stop):
                     self._apply(event.type, event.obj)
+                    self._record_arrival(rv, (event,))
             except Exception as err:
                 self._m_listwatch_errors.inc()
                 klog.errorf("informer %s: list/watch failed: %s", self.kind, err)
                 stop.wait(1.0)
+
+    def _record_arrival(self, cursor: str, events: tuple, relist: bool = False) -> None:
+        """Incident capture (ISSUE 19): list/watch arrivals are THE
+        external input of the live informer plane — record them at the
+        wire boundary, before dispatch fans out.  (The sim's
+        cooperative pump records its own batches; this path only runs
+        in the threaded live loop.)"""
+        try:
+            from ..sim.capture import active
+
+            tap = active()
+            if tap is not None:
+                tap.record_informer_batch(
+                    "live", self.kind, list(events),
+                    cursor=cursor, relist=relist, delivered=len(events),
+                )
+        except Exception:
+            pass  # the tap must never fail the watch loop
 
     def _relist(self) -> str:
         objs, rv = self._client.list(self.kind)

@@ -303,6 +303,22 @@ def build_parser() -> argparse.ArgumentParser:
         "'Async mutation pipeline'.",
     )
 
+    controller.add_argument(
+        "--capture-path", default="",
+        help="Arm the incident capture (ISSUE 19): record every "
+        "external input — informer deliveries, AWS call outcomes, "
+        "lease observations, signals — to this bounded JSONL ring for "
+        "deterministic replay (agac explain --capture / "
+        "sim.replay.ReplayHarness). '%%p' expands to the PID. Default "
+        "off (env AGAC_CAPTURE_PATH).",
+    )
+    controller.add_argument(
+        "--capture-max-bytes", type=int, default=0,
+        help="Incident-capture ring size: the active segment rotates "
+        "to <path>.1 past this many bytes (at most two segments kept). "
+        "Default 16MiB (env AGAC_CAPTURE_MAX_BYTES).",
+    )
+
     webhook = sub.add_parser("webhook", help="Start webhook server")
     webhook.add_argument(
         "--tls-cert-file", default="",
@@ -373,6 +389,18 @@ def build_parser() -> argparse.ArgumentParser:
     explain.add_argument(
         "--timeout", type=float, default=3.0,
         help="Per-peer HTTP timeout in seconds.",
+    )
+    explain.add_argument(
+        "--capture", default="",
+        help="Time-machine mode (ISSUE 19): instead of querying live "
+        "peers, replay this incident capture in the deterministic sim "
+        "and answer from the replayed world — the verdict as of "
+        "--at seconds of virtual time.",
+    )
+    explain.add_argument(
+        "--at", type=float, default=-1.0,
+        help="With --capture: the past virtual instant (seconds) to "
+        "stop the replay at before asking. Default: the capture's end.",
     )
 
     sub.add_parser("version", help="Print the version number")
@@ -480,6 +508,39 @@ def run_controller(args) -> int:
         ),
     )
     stop = setup_signal_handler()
+
+    # the incident capture (ISSUE 19): a wall-clock tap over this
+    # controller's whole external-input stream.  Armed before any
+    # informer or AWS traffic so the recording starts at genesis;
+    # closed at exit (the per-record flush makes a SIGKILL'd tail a
+    # tolerated torn record, not a lost capture).
+    capture_path = args.capture_path or os.environ.get("AGAC_CAPTURE_PATH", "")
+    if capture_path:
+        import atexit
+
+        from ..sim import capture as capture_mod
+
+        capture_path = capture_path.replace("%p", str(os.getpid()))
+        max_bytes = (
+            args.capture_max_bytes
+            or int(os.environ.get("AGAC_CAPTURE_MAX_BYTES", "0"))
+            or capture_mod.DEFAULT_MAX_BYTES
+        )
+        tap = capture_mod.IncidentCapture(
+            capture_path, max_bytes=max_bytes,
+            clock_mode="real", source="controller",
+        )
+        capture_mod.install(tap)
+        tap.record_clock("start")
+        klog.infof("incident capture armed: %s (max %d bytes)",
+                   capture_path, max_bytes)
+
+        def _close_capture():
+            tap.record_clock("stop")
+            capture_mod.install(None)
+            tap.close()
+
+        atexit.register(_close_capture)
 
     from ..cloudprovider.aws.factory import (
         configure_api_health,
@@ -760,6 +821,22 @@ def run_explain(args) -> int:
     import urllib.request
 
     from ..observability import explain as obs_explain
+
+    if getattr(args, "capture", ""):
+        # time-machine mode (ISSUE 19): replay the capture to --at
+        # virtual seconds and answer from the replayed world
+        from ..sim.replay import ReplayHarness
+        from ..sim.capture import load_capture
+
+        capture = load_capture(args.capture)
+        with ReplayHarness(capture) as rh:
+            if args.at >= 0:
+                rh.run_to(args.at)
+            else:
+                rh.run_to(float("inf"))
+            answer = rh.explain(args.key, args.controller or None)
+        print(json.dumps(answer, indent=2, sort_keys=True))
+        return 0 if answer.get("verdict") not in ("", "no-live-stack") else 1
 
     peers = [p.strip() for p in args.fleet_peers.split(",") if p.strip()]
     if not peers:

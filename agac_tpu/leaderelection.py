@@ -198,7 +198,19 @@ class LeaderElection:
         """One acquire-or-renew attempt, public for cooperative
         drivers (the sim runtime's elector actors step this explicitly
         instead of running the threaded loops above)."""
-        return self._try_acquire_or_renew(client)
+        acquired, holder = self._try_acquire_or_renew(client)
+        try:
+            from .sim import capture as capture_mod
+
+            tap = capture_mod.active()
+            if tap is not None:
+                tap.record_lease_observation(
+                    f"{self.namespace}/{self.name}", self.identity,
+                    acquired, holder,
+                )
+        except Exception:
+            pass  # the capture tap must never fail an election tick
+        return acquired, holder
 
     def _try_acquire_or_renew(self, client: ClusterClient) -> tuple[bool, str]:
         """Returns (we_are_leader, current_holder)."""

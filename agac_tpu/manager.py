@@ -827,16 +827,24 @@ class _HealthHandler(BaseHTTPRequestHandler):
 
     def _flightrecorder(self, query=None):
         """The flight recorder's ring buffer, oldest → newest — the
-        live post-mortem of the last few hundred reconcile outcomes."""
+        live post-mortem of the last few hundred reconcile outcomes.
+        The active incident capture's cursor (ISSUE 19) rides along,
+        naming the replayable artifact this window corresponds to."""
         recorder = self.server.flight_recorder
-        self._respond(
-            200,
-            {
-                "capacity": recorder.capacity,
-                "recorded_total": recorder.recorded_total,
-                "entries": recorder.dump(),
-            },
-        )
+        body = {
+            "capacity": recorder.capacity,
+            "recorded_total": recorder.recorded_total,
+            "entries": recorder.dump(),
+        }
+        try:
+            from .sim.capture import active as _capture_active
+
+            tap = _capture_active()
+            if tap is not None:
+                body["capture_cursor"] = tap.cursor()
+        except Exception:
+            pass
+        self._respond(200, body)
 
     def _queues(self, query=None):
         self._respond(200, self.server.queue_status())

@@ -77,6 +77,21 @@ class FlightRecorder:
             "flight recorder: dumping last %d of %d recorded entries",
             len(entries), self.recorded_total,
         )
+        # the active incident capture's cursor (ISSUE 19): the dying
+        # pod names the exact capture window — file, byte offset, last
+        # event serial — so the post-mortem points straight at the
+        # replayable artifact.  Contained like the rest of the dump.
+        try:
+            from ..sim.capture import active
+
+            tap = active()
+            if tap is not None:
+                klog.infof(
+                    "flight capture-cursor %s",
+                    json.dumps(tap.cursor(), separators=(",", ":"), sort_keys=True),
+                )
+        except Exception:
+            pass
         for entry in entries:
             try:
                 klog.infof("flight %s", json.dumps(entry, separators=(",", ":"), sort_keys=True))

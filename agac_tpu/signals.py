@@ -25,6 +25,18 @@ def setup_signal_handler() -> threading.Event:
     def handler(signum, frame):
         if stop.is_set():
             os._exit(1)  # second signal: exit directly
+        # incident capture (ISSUE 19): the delivered signal is itself
+        # an external input — it lands on the capture chain before the
+        # post-mortems run, so a replay re-raises it at the same slot.
+        # Strictly contained, like every tap.
+        try:
+            from .sim.capture import active
+
+            tap = active()
+            if tap is not None:
+                tap.record_signal(signum)
+        except Exception:
+            pass
         # flight-recorder post-mortem (ISSUE 5): a terminating pod's
         # log is the one artifact the kubelet keeps, so the last
         # reconcile outcomes go there before shutdown begins.  Strictly

@@ -18,16 +18,28 @@ completes in minutes of wall clock.
 - ``oracles``: the invariant checks every scenario runs against;
 - ``fuzz``: the hypothesis-compatible scenario fuzzer composing
   ``FaultPlan`` primitives (crash × throttle × brownout × racing spec
-  edits × leader churn) with seed replay.
+  edits × leader churn) with seed replay;
+- ``capture``/``replay``: the incident time machine (ISSUE 19) — the
+  bounded external-input recording of a live or chaos run, and the
+  harness that feeds it back through the real manager stack on
+  virtual time with first-divergent-event bisection.
 """
 
+from .capture import Capture, IncidentCapture, load_capture
 from .runtime import SimClock, SimScheduler, installed
 from .harness import SimHarness, SimHarnessConfig
+from .replay import ReplayHarness, ReplayResult, replay_capture
 
 __all__ = [
+    "Capture",
+    "IncidentCapture",
+    "ReplayHarness",
+    "ReplayResult",
     "SimClock",
     "SimScheduler",
     "SimHarness",
     "SimHarnessConfig",
     "installed",
+    "load_capture",
+    "replay_capture",
 ]

@@ -1116,6 +1116,44 @@ def _schedule_brownout(
 # ---------------------------------------------------------------------------
 
 
+def replay_corpus(directory) -> int:
+    """Replay every checked-in incident capture under ``directory``
+    (the CI ``replay-corpus`` gate, ISSUE 19): each must re-run
+    byte-identically and pass the oracle battery.  A capture that
+    stops replaying identically means a behavior change reached the
+    recorded external-input contract — either fix the regression or
+    deliberately re-record the capture."""
+    from .replay import replay_capture
+
+    paths = sorted(directory.glob("*.jsonl"))
+    if not paths:
+        print(f"no captures under {directory}")
+        return 0
+    failures = 0
+    for path in paths:
+        try:
+            result = replay_capture(path)
+        except Exception as err:
+            print(f"{path.name} FAIL replay crashed: {err!r}")
+            failures += 1
+            continue
+        ok = result.identical and not result.violations
+        print(
+            f"{path.name} {'ok' if ok else 'FAIL'} "
+            f"events={result.recorded_events} "
+            f"hash={result.recorded_hash[:16]}"
+        )
+        if not ok:
+            failures += 1
+            if result.divergence is not None:
+                print(result.divergence.describe())
+            for violation in result.violations:
+                print(f"  - {violation}")
+            for note in result.notes:
+                print(f"  note: {note}")
+    return 1 if failures else 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     import argparse
     import pathlib
@@ -1143,7 +1181,18 @@ def main(argv: Optional[list[str]] = None) -> int:
         "objective is a regression)",
     )
     parser.add_argument("--artifacts", default=None)
+    parser.add_argument(
+        "--captures", default=None, metavar="DIR",
+        help="replay-corpus mode: replay every incident capture "
+        "(*.jsonl) under DIR through the ReplayHarness and require a "
+        "byte-identical event-trace hash plus a clean oracle battery; "
+        "exits non-zero on any divergence — the regression gate for "
+        "checked-in captures (seeds are ignored in this mode)",
+    )
     args = parser.parse_args(argv)
+
+    if args.captures:
+        return replay_corpus(pathlib.Path(args.captures))
 
     failures = 0
     for seed in [int(s) for s in args.seeds.split(",") if s]:

@@ -37,9 +37,12 @@ one hash — the replay contract ``sim/fuzz.py`` builds on.
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
+import os
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Generator, Optional
+from typing import Any, Callable, Generator, Optional
 
 from .. import klog
 from ..autoscaler import (
@@ -84,10 +87,13 @@ from ..observability import journey as obs_journey
 from ..observability import metrics as obs_metrics
 from ..observability import recorder as obs_recorder
 from ..observability import slo as obs_slo
+from ..cluster import serde
 from ..reconcile.pending import PendingSettleTable
 from ..reconcile.reconcile import process_next_work_item
 from ..sharding import ShardingConfig
+from . import capture as capture_mod
 from . import runtime
+from .capture import IncidentCapture
 
 # a pump round that never quiesces within this many worker steps is a
 # livelock (an item requeueing itself with zero delay) — fail loudly
@@ -181,6 +187,78 @@ class SimHarnessConfig:
     # (drain starts only once the adopter is standing by, so the gap
     # is bounded by tick interleaving, not lease expiry)
     handoff_window_budget: float = 0.0
+    # incident capture (ISSUE 19): a non-empty path arms an
+    # ``IncidentCapture`` tap for the harness's lifetime — every
+    # external input (informer batches, AWS outcomes, lease
+    # observations, scenario verbs and cluster writes) lands in the
+    # bounded JSONL ring so a failed drill replays through
+    # ``sim.replay.ReplayHarness``.  The ``AGAC_SIM_CAPTURE`` env var
+    # arms the same tap without touching the scenario (the chaos
+    # suites' capture-on-failure teardown path).
+    capture_path: Optional[str] = None
+    capture_max_bytes: int = capture_mod.DEFAULT_MAX_BYTES
+
+
+# config fields the capture header cannot round-trip (callable-bearing
+# or element-type-erased tuples); a capture made with one set records
+# its presence so the replay can warn instead of silently differing
+_CONFIG_OPAQUE_FIELDS = ("slo_objectives", "slo_windows", "autoscale_policy")
+
+
+def encode_config(config: SimHarnessConfig) -> dict:
+    """Capture-header encoding of the harness config: scalars verbatim,
+    nested dataclasses via the serde wire format, opaque fields listed
+    by name (the replay restores defaults and warns)."""
+    out: dict[str, Any] = {}
+    for f in dataclasses.fields(config):
+        value = getattr(config, f.name)
+        if f.name in _CONFIG_OPAQUE_FIELDS:
+            if value is not None:
+                out.setdefault("__opaque__", []).append(f.name)
+            continue
+        if value is None:
+            continue
+        if dataclasses.is_dataclass(value) and not isinstance(value, type):
+            out[f.name] = {"__dc__": type(value).__name__, "fields": serde.to_wire(value)}
+        else:
+            out[f.name] = value
+    return out
+
+
+def decode_config(data: dict) -> SimHarnessConfig:
+    """Inverse of ``encode_config``; the replayed harness never
+    re-captures (``capture_path`` is stripped — the shadow stream is
+    in-memory by construction)."""
+    kwargs: dict[str, Any] = {}
+    for f in dataclasses.fields(SimHarnessConfig):
+        if f.name not in data or f.name in ("capture_path",):
+            continue
+        value = data[f.name]
+        if isinstance(value, (dict, list)):
+            value = capture_mod.decode_value(value)
+        kwargs[f.name] = value
+    return SimHarnessConfig(**kwargs)
+
+
+# one process may build many harnesses (a pytest chaos module, a fuzz
+# batch); each gets a distinct capture file under the armed base path
+_capture_serials: dict[str, int] = {}
+_capture_serial_lock = threading.Lock()
+
+
+def _next_capture_path(base: str) -> str:
+    """The nth harness writing the SAME ``base`` in this process gets
+    ``-n`` spliced before the extension (the first keeps the bare
+    path) — distinct bases stay untouched, so sequential tests with
+    their own paths name their artifacts predictably while a
+    multi-harness drill sharing one knob never clobbers itself."""
+    with _capture_serial_lock:
+        serial = _capture_serials.get(base, 0) + 1
+        _capture_serials[base] = serial
+    if serial == 1:
+        return base
+    root, ext = os.path.splitext(base)
+    return f"{root}-{serial}{ext or '.jsonl'}"
 
 
 class _World:
@@ -327,13 +405,17 @@ class _Stack:
             health=self.world.health,
             metrics_registry=self.world.registry,
         )
+        # stacks hold the RAW cluster: controller writes (status
+        # updates, finalizers, leases) are consequences a replay
+        # re-derives, never recorded external inputs
         self.informer_factory = SharedInformerFactory(
-            harness.cluster,
+            harness._raw_cluster,
             harness.config.resync_period,
             clock=harness.scheduler.monotonic,
         )
         self.manager.build(
-            harness.cluster, config, self.world.cloud_factory, self.informer_factory
+            harness._raw_cluster, config, self.world.cloud_factory,
+            self.informer_factory,
         )
         self.manager.settle_table = self.world.settle_table
         # initial list+sync, then per-informer watch cursors
@@ -348,15 +430,27 @@ class _Stack:
 
     def pump_informers(self, harness: "SimHarness") -> bool:
         """Apply new cluster events to every informer and dispatch
-        handler deltas inline; True when anything moved."""
+        handler deltas inline; True when anything moved.  With a
+        capture armed every delivered batch (and every 410-degraded
+        relist) lands in the tap — the informer half of the incident
+        time machine; with a replay's ``informer_feed`` substituted,
+        recorded batches are applied instead of live cluster deltas."""
+        if harness.informer_feed is not None:
+            return self._pump_recorded(harness)
         moved = False
+        tap = harness.capture
         for informer in self.informer_factory.informers():
-            events, cursor = harness.cluster.events_since(
+            events, cursor = harness._raw_cluster.events_since(
                 informer.kind, self.cursors[informer]
             )
             if events is None:
                 # watch window trimmed (the 410 Gone analog): relist
                 self.cursors[informer] = informer.sync_once()
+                if tap is not None:
+                    tap.record_informer_batch(
+                        self.identity, informer.kind, [],
+                        cursor=self.cursors[informer], relist=True, delivered=0,
+                    )
                 harness.scheduler.record("informer", f"{informer.kind}:relist")
                 moved = True
                 continue
@@ -365,10 +459,58 @@ class _Stack:
             self.cursors[informer] = cursor
             delivered = informer.drain_pending_deltas()
             if events or delivered:
+                if tap is not None:
+                    tap.record_informer_batch(
+                        self.identity, informer.kind, events,
+                        cursor=cursor, relist=False, delivered=delivered,
+                    )
                 harness.scheduler.record(
                     "informer", f"{informer.kind}:{len(events)}"
                 )
                 moved = True
+        return moved
+
+    def _pump_recorded(self, harness: "SimHarness") -> bool:
+        """Replay-substitution pump: apply the recorded watch batches
+        that are due at (or before) the current virtual instant for
+        this stack's identity, in recorded order, instead of reading
+        the live cluster — the live-capture replay path where the
+        recorded stream IS the truth."""
+        moved = False
+        feed = harness.informer_feed
+        tap = harness.capture
+        now = harness.scheduler.monotonic()
+        for informer in self.informer_factory.informers():
+            for batch in feed.due(self.identity, informer.kind, now):
+                if batch.get("relist"):
+                    self.cursors[informer] = informer.sync_once()
+                    if tap is not None:
+                        tap.record_informer_batch(
+                            self.identity, informer.kind, [],
+                            cursor=self.cursors[informer],
+                            relist=True, delivered=0,
+                        )
+                    harness.scheduler.record(
+                        "informer", f"{informer.kind}:relist"
+                    )
+                    moved = True
+                    continue
+                events = feed.decode_events(batch)
+                for event in events:
+                    informer.apply_event(event)
+                self.cursors[informer] = batch.get("cursor", "")
+                delivered = informer.drain_pending_deltas()
+                if events or delivered:
+                    if tap is not None:
+                        tap.record_informer_batch(
+                            self.identity, informer.kind, events,
+                            cursor=self.cursors[informer],
+                            relist=False, delivered=delivered,
+                        )
+                    harness.scheduler.record(
+                        "informer", f"{informer.kind}:{len(events)}"
+                    )
+                    moved = True
         return moved
 
     def resync(self, harness: "SimHarness") -> None:
@@ -405,7 +547,9 @@ class _SimElector:
     def tick(self) -> None:
         if self.dead:
             return
-        acquired, _holder = self.elector.try_acquire_or_renew(self.harness.cluster)
+        acquired, _holder = self.elector.try_acquire_or_renew(
+            self.harness._raw_cluster
+        )
         now = self.harness.scheduler.monotonic()
         if not self.leading:
             if acquired:
@@ -438,7 +582,7 @@ class _SimElector:
         self.leading = False
         self.elector.set_leading(False)
         self.event.cancel()
-        self.elector._release(self.harness.cluster)
+        self.elector._release(self.harness._raw_cluster)
 
 
 class _ShardReplica:
@@ -482,7 +626,7 @@ class _ShardReplica:
             return
         manager = self.stack.manager
         try:
-            changed = manager.shard_tick(self.harness.cluster)
+            changed = manager.shard_tick(self.harness._raw_cluster)
         except SimulatedCrash as crash:
             self.harness._handle_crash_replica(self, crash)
             return
@@ -510,7 +654,86 @@ class _ShardReplica:
         leases for immediate takeover."""
         self.dead = True
         self.tick_event.cancel()
-        self.stack.manager.shard_membership.release_all(self.harness.cluster)
+        self.stack.manager.shard_membership.release_all(
+            self.harness._raw_cluster
+        )
+
+
+class _RecordingCluster:
+    """The scenario-facing cluster handle while a capture is armed:
+    reads pass through untouched; the four mutators record a
+    ``cluster`` event AFTER the apiserver accepts them — these writes
+    are EXTERNAL inputs (the drill script's own actions), so a replay
+    re-injects them at their recorded instants.  Controller-internal
+    writes never flow here: stacks, electors and membership hold the
+    raw cluster, because their writes are consequences the replay
+    re-derives, not inputs."""
+
+    def __init__(self, inner, harness: "SimHarness"):
+        self._inner = inner
+        self._harness = harness
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _record(self, method: str, kind: str, namespace="", name="", obj=None) -> None:
+        tap = self._harness.capture
+        if tap is not None:
+            tap.record_cluster_mutation(
+                method, kind, namespace=namespace, name=name, obj=obj
+            )
+
+    def create(self, kind, obj):
+        result = self._inner.create(kind, obj)
+        self._record("create", kind, obj=obj)
+        return result
+
+    def update(self, kind, obj):
+        result = self._inner.update(kind, obj)
+        self._record("update", kind, obj=obj)
+        return result
+
+    def update_status(self, kind, obj):
+        result = self._inner.update_status(kind, obj)
+        self._record("update_status", kind, obj=obj)
+        return result
+
+    def delete(self, kind, namespace, name):
+        self._inner.delete(kind, namespace, name)
+        self._record("delete", kind, namespace=namespace or "", name=name)
+
+
+_AWS_SEED_HELPERS = frozenset(
+    {"add_load_balancer", "add_hosted_zone", "set_load_balancer_state"}
+)
+
+
+class _RecordingAWS:
+    """The scenario-facing AWS handle while a capture is armed: the
+    seed helpers (LB registration, hosted-zone creation, LB state
+    flips) are EXTERNAL inputs — a drill script conjuring the world —
+    so they land on the capture chain as ``aws_seed`` control events
+    and a replay re-injects them at their recorded instants.  API ops
+    and oracle reads pass straight through; their outcomes are
+    captured separately at the instrumented driver seam."""
+
+    def __init__(self, inner, harness: "SimHarness"):
+        self._inner = inner
+        self._harness = harness
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name not in _AWS_SEED_HELPERS:
+            return attr
+
+        def seeded(*args, **kwargs):
+            result = attr(*args, **kwargs)
+            self._harness._record_control(
+                "aws_seed", method=name, args=list(args), kwargs=dict(kwargs)
+            )
+            return result
+
+        return seeded
 
 
 class SimHarness:
@@ -527,11 +750,20 @@ class SimHarness:
         cluster: Optional[FakeCluster] = None,
         aws: Optional[FakeAWSBackend] = None,
         config: Optional[SimHarnessConfig] = None,
+        capture: Optional[IncidentCapture] = None,
     ):
         self.config = config or SimHarnessConfig()
         self.scheduler = runtime.SimScheduler()
         self._given_cluster = cluster
         self._given_aws = aws
+        self._given_capture = capture
+        # the incident tap (ISSUE 19), armed in __enter__; the replay
+        # harness sets informer_feed to substitute recorded watch
+        # batches for live cluster deltas
+        self.capture: Optional[IncidentCapture] = None
+        self.informer_feed = None
+        self._prev_capture: Optional[IncidentCapture] = None
+        self._internal_verbs = 0
         self._installed = False
         self._stack: Optional[_Stack] = None
         self._electors: list[_SimElector] = []
@@ -581,8 +813,8 @@ class SimHarness:
         )
         self._installed = True
         config = self.config
-        self.cluster = self._given_cluster or FakeCluster()
-        if not hasattr(self.cluster, "events_since"):
+        self._raw_cluster = self._given_cluster or FakeCluster()
+        if not hasattr(self._raw_cluster, "events_since"):
             raise TypeError(
                 "SimHarness needs a cluster with events_since (FakeCluster)"
             )
@@ -596,6 +828,31 @@ class SimHarness:
         if self.aws.fault_plan is None:
             self.aws.install_fault_plan(FaultPlan(exempt_creator=False))
         self.fault_plan = self.aws.fault_plan
+
+        # the incident capture tap (ISSUE 19): armed by an explicit
+        # IncidentCapture (the replay's shadow stream), the config knob,
+        # or the AGAC_SIM_CAPTURE env var (chaos-suite teardowns).  The
+        # header snapshots cluster + config so a replay reconstructs
+        # the world; scenario-facing cluster writes flow through the
+        # recording proxy, while stacks/electors keep the raw handle.
+        self.capture = self._given_capture
+        if self.capture is None:
+            path = config.capture_path or os.environ.get("AGAC_SIM_CAPTURE")
+            if path:
+                self.capture = IncidentCapture(
+                    _next_capture_path(path),
+                    max_bytes=config.capture_max_bytes,
+                    clock_mode="virtual",
+                    source="sim",
+                    snapshot_fn=self._capture_snapshot,
+                )
+        if self.capture is not None:
+            self._prev_capture = capture_mod.install(self.capture)
+            self.capture.record_clock("start")
+            self.cluster = _RecordingCluster(self._raw_cluster, self)
+            self.aws = _RecordingAWS(self.aws, self)
+        else:
+            self.cluster = self._raw_cluster
 
         # the convergence SLO plane (ISSUE 9): one fleet-scoped journey
         # tracker + SLO engine per scenario, on virtual time, installed
@@ -666,13 +923,55 @@ class SimHarness:
         self.scheduler.every(
             config.resync_period, self._resync_tick, "informer-resync", priority=1
         )
-        if self._sharded:
-            for _ in range(config.replicas):
-                self.add_shard_replica()
-        else:
-            for _ in range(config.replicas):
-                self._add_replica()
+        with self._internal():
+            if self._sharded:
+                for _ in range(config.replicas):
+                    self.add_shard_replica()
+            else:
+                for _ in range(config.replicas):
+                    self._add_replica()
         return self
+
+    # ------------------------------------------------------------------
+    # incident capture (ISSUE 19)
+    # ------------------------------------------------------------------
+    def _capture_snapshot(self) -> dict:
+        """The capture header's world snapshot: enough to rebuild this
+        harness — config plus the cluster store (rv-ordered, with the
+        rv counter, so the replay mints the same resourceVersion
+        stream).  Re-taken at every ring rotation."""
+        objects: list = []
+        rv = 0
+        if hasattr(self._raw_cluster, "snapshot"):
+            pairs, rv = self._raw_cluster.snapshot()
+            objects = [
+                {"kind": kind, "obj": capture_mod.encode_value(obj)}
+                for kind, obj in pairs
+            ]
+        snapshot = {
+            "config": encode_config(self.config),
+            "cluster": {"resourceVersion": rv, "objects": objects},
+        }
+        if hasattr(self.aws, "snapshot_state"):
+            snapshot["aws"] = self.aws.snapshot_state()
+        return snapshot
+
+    @contextlib.contextmanager
+    def _internal(self):
+        """Scope marking harness-initiated verbs: control events
+        recorded inside carry origin=internal, so a replay knows they
+        re-derive (crash handling, replacement replicas, autoscaler
+        resizes) instead of needing re-injection."""
+        self._internal_verbs += 1
+        try:
+            yield
+        finally:
+            self._internal_verbs -= 1
+
+    def _record_control(self, action: str, **fields) -> None:
+        if self.capture is not None:
+            origin = "internal" if self._internal_verbs else "external"
+            self.capture.record_control(action, origin=origin, **fields)
 
     def _wire_autoscaler(self) -> None:
         """Build the harness-level AutoscalerLoop: signals from the
@@ -717,10 +1016,17 @@ class SimHarness:
             clock=self.scheduler.monotonic,
         )
         policy = ScalePolicy(config.autoscale_policy or ScalePolicyConfig())
+
+        def execute_resize(target_count: int) -> int:
+            # autoscaler resizes re-derive on replay (the loop runs
+            # again over the same signals) — internal origin
+            with self._internal():
+                return self.request_resize(target_count)
+
         self.autoscaler = AutoscalerLoop(
             signals,
             policy,
-            execute=self.request_resize,
+            execute=execute_resize,
             registry=self.journey_registry,
             flight_recorder=self.autoscaler_recorder,
         )
@@ -772,6 +1078,11 @@ class SimHarness:
         from .. import clockseam
 
         self._installed = False
+        if self.capture is not None:
+            self.capture.record_clock("stop")
+            capture_mod.install(self._prev_capture)
+            if self.capture is not self._given_capture:
+                self.capture.close()
         obs_journey.install(self._prev_journey)
         obs_slo.install_engine(self._prev_slo)
         clockseam.reset()
@@ -795,6 +1106,7 @@ class SimHarness:
         replica = _ShardReplica(self, f"shard-replica-{self._replica_serial}")
         self._replicas.append(replica)
         self.generations += 1
+        self._record_control("add_shard_replica", identity=replica.identity)
         if self.on_stack_built is not None:
             self.on_stack_built(self, replica.stack)
         return replica
@@ -814,10 +1126,15 @@ class SimHarness:
             if replica.dead:
                 continue
             if identity is None or replica.identity == identity:
+                self._record_control(
+                    "kill_shard_replica",
+                    identity=replica.identity, replace=replace,
+                )
                 self.scheduler.record("shard", f"killed:{replica.identity}")
                 replica.kill()
                 if replace:
-                    self.add_shard_replica()
+                    with self._internal():
+                        self.add_shard_replica()
                 return replica.identity
         raise RuntimeError(f"no live shard replica matching {identity!r}")
 
@@ -829,6 +1146,9 @@ class SimHarness:
             if replica.dead:
                 continue
             if identity is None or replica.identity == identity:
+                self._record_control(
+                    "stop_shard_replica", identity=replica.identity
+                )
                 self.scheduler.record("shard", f"released:{replica.identity}")
                 replica.stop()
                 return replica.identity
@@ -849,8 +1169,9 @@ class SimHarness:
         exclusive-ownership oracle arms itself for the transition."""
         from ..sharding import request_resize as _request_resize
 
-        epoch = _request_resize(self.cluster, target_count)
+        epoch = _request_resize(self._raw_cluster, target_count)
         self._resize_requests.append(target_count)
+        self._record_control("request_resize", target=target_count, epoch=epoch)
         self.scheduler.record("resize", f"target:{target_count}@e{epoch}")
         return epoch
 
@@ -1022,10 +1343,12 @@ class SimHarness:
         is preserved."""
         for elector in self._electors:
             if self._stack is not None and elector.identity == self._stack.identity:
+                self._record_control("kill_leader", identity=elector.identity)
                 self.scheduler.record("leader", f"killed:{elector.identity}")
                 elector.kill()
                 self._drop_stack()
-                self._add_replica()
+                with self._internal():
+                    self._add_replica()
                 return
         raise RuntimeError("no leader to kill")
 
@@ -1033,7 +1356,10 @@ class SimHarness:
         klog.warningf("sim: %s — killing leader generation", crash)
         self.scheduler.record("crash", f"{crash.op}:{crash.when}")
         if self._stack is not None:
-            self.kill_leader()
+            # crash recovery is a CONSEQUENCE of the recorded fault
+            # plan, not a scenario verb — internal for the replay
+            with self._internal():
+                self.kill_leader()
 
     def _handle_crash_replica(
         self, replica: "_ShardReplica", crash: SimulatedCrash
@@ -1046,16 +1372,19 @@ class SimHarness:
         self.scheduler.record("crash", f"{crash.op}:{crash.when}")
         self.scheduler.record("shard", f"crashed:{replica.identity}")
         replica.kill()
-        self.add_shard_replica()
+        with self._internal():
+            self.add_shard_replica()
 
     def demote_leader(self) -> None:
         """Gracefully stop the leading replica (lease released)."""
         for elector in self._electors:
             if self._stack is not None and elector.identity == self._stack.identity:
+                self._record_control("demote_leader", identity=elector.identity)
                 self.scheduler.record("leader", f"released:{elector.identity}")
                 elector.release()
                 self._drop_stack()
-                self._add_replica()
+                with self._internal():
+                    self._add_replica()
                 return
         raise RuntimeError("no leader to demote")
 

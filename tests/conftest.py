@@ -14,6 +14,86 @@ env vars below are kept for environments with a stock jax.
 """
 
 import os
+import pathlib
+import shutil
+
+import pytest
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+
+# ---------------------------------------------------------------------------
+# capture-on-failure (ISSUE 19): the chaos/process e2e tiers arm the
+# incident capture for every drill; a red test keeps the recording as
+# incident-captures/incident-capture-<test>-*.jsonl — the replayable
+# artifact CI uploads, and the seed for a sim regression test.
+# ---------------------------------------------------------------------------
+
+KEPT_CAPTURE_DIR = pathlib.Path("incident-captures")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    setattr(item, f"_agac_report_{report.when}", report)
+
+
+@pytest.fixture
+def incident_capture_on_failure(request, tmp_path):
+    """Arm every capture entry point for the duration of one test:
+
+    - an in-process wall-clock tap (threads started by the test — the
+      chaos fleet drills — record through ``capture.active()``);
+    - ``AGAC_CAPTURE_PATH`` with a ``%p`` slot (controller
+      subprocesses — the process-kill drills — each write their own
+      segment);
+    - ``AGAC_SIM_CAPTURE`` (any sim harness the test builds).
+
+    On teardown the recordings are discarded when the test passed and
+    kept under ``incident-captures/`` when it failed."""
+    from agac_tpu.sim import capture as capture_mod
+
+    capture_dir = tmp_path / "incident-capture"
+    capture_dir.mkdir()
+    saved_env = {
+        name: os.environ.get(name)
+        for name in ("AGAC_CAPTURE_PATH", "AGAC_SIM_CAPTURE")
+    }
+    os.environ["AGAC_CAPTURE_PATH"] = str(capture_dir / "controller-%p.jsonl")
+    os.environ["AGAC_SIM_CAPTURE"] = str(capture_dir / "sim.jsonl")
+    tap = capture_mod.IncidentCapture(
+        str(capture_dir / "live.jsonl"), clock_mode="real", source="test"
+    )
+    previous = capture_mod.install(tap)
+    try:
+        yield
+    finally:
+        capture_mod.install(previous)
+        tap.close()
+        for name, value in saved_env.items():
+            if value is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = value
+        failed = any(
+            getattr(report, "failed", False)
+            for report in (
+                getattr(request.node, "_agac_report_setup", None),
+                getattr(request.node, "_agac_report_call", None),
+            )
+        )
+        if failed:
+            KEPT_CAPTURE_DIR.mkdir(exist_ok=True)
+            slug = request.node.name.replace("/", "_").replace("[", "-").strip("]")
+            kept = []
+            for artifact in sorted(capture_dir.glob("*.jsonl*")):
+                target = KEPT_CAPTURE_DIR / f"incident-capture-{slug}-{artifact.name}"
+                shutil.copyfile(artifact, target)
+                kept.append(str(target))
+            if kept:
+                print(
+                    "incident capture kept (replay: python -m agac_tpu.sim.fuzz"
+                    f" --captures {KEPT_CAPTURE_DIR}/): " + ", ".join(kept)
+                )

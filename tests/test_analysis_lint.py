@@ -1446,6 +1446,108 @@ class TestCrossBoundaryCapture:
         assert run(src, path="agac_tpu/sim/executor.py") == []
 
 
+# ---------------------------------------------------------------------------
+# untapped-external-input
+# ---------------------------------------------------------------------------
+
+
+class TestUntappedExternalInput:
+    def test_untapped_informer_delivery_fires_once(self):
+        v = only(
+            run(
+                """
+                def pump(self, informer, events):
+                    for event in events:
+                        informer.apply_event(event)
+                """,
+                path="agac_tpu/sim/pump.py",
+            ),
+            "untapped-external-input",
+        )
+        assert "informer event delivery" in v.message
+
+    def test_tapped_informer_delivery_is_clean(self):
+        src = """
+            def pump(self, informer, events, tap):
+                for event in events:
+                    informer.apply_event(event)
+                if tap is not None:
+                    tap.record_informer_batch(self.identity, informer.kind, events)
+        """
+        assert run(src, path="agac_tpu/sim/pump.py") == []
+
+    def test_untapped_outcome_classification_fires_once(self):
+        v = only(
+            run(
+                """
+                def observed(trace, service, op, start, end, outcome):
+                    trace.record_call(service, op, start, end, outcome)
+                """,
+                path="agac_tpu/observability/wrapping.py",
+            ),
+            "untapped-external-input",
+        )
+        assert "outcome classification" in v.message
+
+    def test_tapped_outcome_classification_is_clean(self):
+        src = """
+            def observed(trace, tap, service, op, start, end, outcome):
+                trace.record_call(service, op, start, end, outcome)
+                if tap is not None:
+                    tap.record_aws_call(service, op, outcome, None, None)
+        """
+        assert run(src, path="agac_tpu/observability/wrapping.py") == []
+
+    def test_untapped_signal_registration_fires(self):
+        v = only(
+            run(
+                """
+                import signal
+
+                def install(stop):
+                    def handler(signum, frame):
+                        stop.set()
+                    signal.signal(signal.SIGTERM, handler)
+                """,
+                path="agac_tpu/shutdown.py",
+            ),
+            "untapped-external-input",
+        )
+        assert "signal handler registration" in v.message
+
+    def test_nested_handler_feeding_the_tap_discharges(self):
+        src = """
+            import signal
+
+            def install(stop):
+                def handler(signum, frame):
+                    from .sim.capture import active
+                    tap = active()
+                    if tap is not None:
+                        tap.record_signal(signum)
+                    stop.set()
+                signal.signal(signal.SIGTERM, handler)
+        """
+        assert run(src, path="agac_tpu/shutdown.py") == []
+
+    def test_capture_plane_itself_is_exempt(self):
+        src = """
+            def pump(self, informer, events):
+                for event in events:
+                    informer.apply_event(event)
+        """
+        assert run(src, path="agac_tpu/sim/capture.py") == []
+        assert run(src, path="agac_tpu/sim/replay.py") == []
+
+    def test_suppression_with_justification_is_honored(self):
+        src = """
+            def pump(self, informer, events):
+                for event in events:
+                    informer.apply_event(event)  # agac-lint: ignore[untapped-external-input] -- bench-only pump, never captured
+        """
+        assert run(src, path="agac_tpu/bench_support.py") == []
+
+
 def test_rule_registry_ships_the_documented_rules():
     ids = {r.id for r in RULES}
     assert ids == {
@@ -1465,6 +1567,7 @@ def test_rule_registry_ships_the_documented_rules():
         "journey-stage-without-stamp",
         "unattributed-stage",
         "unexplained-requeue",
+        "untapped-external-input",
     }
 
 

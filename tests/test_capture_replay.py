@@ -1,0 +1,366 @@
+"""The incident time machine (ISSUE 19): capture format, deterministic
+replay, divergence bisection, and the as-of explain queries.
+
+Four planes under test:
+
+- **format** — the versioned JSONL segment ring: header-first layout,
+  version gating, bounded rotation with chain carry-over, and the
+  torn-tail tolerance a crashed writer demands;
+- **replay identity** — a captured sim run (including the acceptance
+  drill: GA brownout + circuit-open + leader kill) re-runs through the
+  REAL manager stack byte-identically: same rolling event-trace hash,
+  clean oracle battery;
+- **bisection** — the seeded-mutation canary: corrupt exactly one
+  recorded outcome (rechaining the tape so it stays internally
+  consistent) and the bisector must name exactly that event;
+- **time machine** — ``run_to(t)`` + ``explain`` re-derives verdicts
+  at any past virtual instant: mid-brownout the service key reads
+  ``circuit-open``, at the end it reads ``converged``.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import threading
+import urllib.request
+
+import pytest
+
+from agac_tpu.cloudprovider.aws.health import GA_OPS, HealthConfig
+from agac_tpu.manager import make_health_server
+from agac_tpu.observability.recorder import FlightRecorder
+from agac_tpu.sim import (
+    IncidentCapture,
+    ReplayHarness,
+    SimHarness,
+    load_capture,
+    replay_capture,
+)
+from agac_tpu.sim import capture as capture_mod
+from agac_tpu.sim.capture import CaptureFormatError
+from agac_tpu.sim.replay import bisect_divergence, explain_at
+
+from .fixtures import NLB_HOSTNAME, NLB_NAME, NLB_REGION, make_lb_service
+from .test_sim_e2e import converge, world_config
+
+# ---------------------------------------------------------------------------
+# captured scenarios (module-scoped: each records once, many tests read)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def simple_capture_path(tmp_path_factory) -> str:
+    """A plain converge run: seed an NLB, create the Service while the
+    leader is already up (so a real informer watch batch lands on the
+    tape), converge."""
+    path = str(tmp_path_factory.mktemp("cap") / "simple.jsonl")
+    with SimHarness(config=world_config(capture_path=path)) as harness:
+        harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        harness.run_for(30)
+        harness.cluster.create("Service", make_lb_service())
+        converge(harness)
+    return path
+
+
+@pytest.fixture(scope="module")
+def drill_capture_path(tmp_path_factory) -> str:
+    """The acceptance drill: GA brownout long enough to open the
+    circuit, leader killed mid-outage, recovery, reconvergence —
+    captured live."""
+    path = str(tmp_path_factory.mktemp("cap") / "drill.jsonl")
+    config = world_config(
+        capture_path=path,
+        health=HealthConfig(
+            window=60.0, min_calls=5, failure_ratio=0.5,
+            open_duration=30.0, probe_budget=1,
+        ),
+    )
+    with SimHarness(config=config) as harness:
+        harness.aws.add_load_balancer(NLB_NAME, NLB_REGION, NLB_HOSTNAME)
+        harness.run_for(30)
+        harness.fault_plan.outage(*GA_OPS)
+        harness.cluster.create("Service", make_lb_service())
+        harness.run_for(120)
+        harness.kill_leader()
+        harness.run_for(60)
+        harness.fault_plan.restore()
+        converge(harness)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# capture format
+# ---------------------------------------------------------------------------
+
+
+class TestCaptureFormat:
+    def test_versioned_header_and_unbroken_chain(self, simple_capture_path):
+        capture = load_capture(simple_capture_path)
+        assert capture.header["version"] == capture_mod.CAPTURE_VERSION
+        assert capture.header["clockMode"] == "virtual"
+        assert capture.header["source"] == "sim"
+        assert capture.header["snapshot"]["config"]
+        assert not capture.truncated
+        assert capture.events, "a converge run must record events"
+        # every record carries its chain hash; verify() recomputes the
+        # whole chain and must find no split
+        assert capture.verify() is None
+        assert capture.final_hash() == capture.events[-1]["hash"]
+        serials = [event["serial"] for event in capture.events]
+        assert serials == list(range(1, len(serials) + 1))
+
+    def test_unknown_version_is_rejected(self, tmp_path):
+        path = tmp_path / "future.jsonl"
+        path.write_text(
+            json.dumps({"record": "header", "version": 999}) + "\n"
+        )
+        with pytest.raises(CaptureFormatError):
+            load_capture(str(path))
+
+    def test_headerless_file_is_rejected(self, tmp_path):
+        path = tmp_path / "bare.jsonl"
+        path.write_text(json.dumps({"record": "event", "serial": 1}) + "\n")
+        with pytest.raises(CaptureFormatError):
+            load_capture(str(path))
+
+    def test_torn_tail_is_tolerated(self, simple_capture_path, tmp_path):
+        """A crashed writer leaves a partial trailing line; loading
+        must keep every complete record and mark the capture."""
+        whole = pathlib.Path(simple_capture_path).read_text()
+        complete = load_capture(simple_capture_path)
+        torn = tmp_path / "torn.jsonl"
+        torn.write_text(whole + '{"record": "event", "serial": 99, "tr')
+        capture = load_capture(str(torn))
+        assert capture.truncated
+        assert len(capture.events) == len(complete.events)
+        assert capture.verify() is None
+
+    def test_bounded_ring_rotates_and_segments_verify(self, tmp_path):
+        path = tmp_path / "ring.jsonl"
+        tap = IncidentCapture(
+            str(path), max_bytes=4096, clock_mode="virtual", source="test"
+        )
+        for i in range(200):
+            tap.record_control(f"tick-{i}", origin="external", i=i)
+        tap.close()
+        assert tap.rotations >= 1
+        assert (tmp_path / "ring.jsonl.1").exists(), "ring keeps one rotated segment"
+        active = load_capture(str(path))
+        previous = load_capture(str(path) + ".1")
+        # each segment verifies stand-alone: the fresh header carries
+        # the chain and base serial where the rotated one left off
+        assert active.verify() is None
+        assert previous.verify() is None
+        assert active.header["baseSerial"] == previous.events[-1]["serial"]
+        assert active.header["chain"] == previous.events[-1]["hash"]
+        # the ring is bounded: at most two segments ever exist
+        assert not (tmp_path / "ring.jsonl.2").exists()
+
+    def test_cursor_names_file_offset_and_serial(self, tmp_path):
+        path = tmp_path / "cursor.jsonl"
+        tap = IncidentCapture(str(path), clock_mode="virtual", source="test")
+        tap.record_control("poke", origin="external")
+        cursor = tap.cursor()
+        tap.close()
+        assert cursor["file"] == str(path)
+        assert cursor["serial"] == 1
+        assert cursor["offset"] == path.stat().st_size
+
+
+# ---------------------------------------------------------------------------
+# replay identity
+# ---------------------------------------------------------------------------
+
+
+class TestReplayIdentity:
+    def test_simple_capture_replays_byte_identically(self, simple_capture_path):
+        result = replay_capture(simple_capture_path)
+        assert result.divergence is None, result.divergence and result.divergence.describe()
+        assert result.recorded_hash == result.replay_hash
+        assert result.identical
+        assert result.replayed_events == result.recorded_events
+        assert result.violations == []
+        assert result.notes == []
+
+    def test_same_capture_twice_yields_the_same_hash(self, simple_capture_path):
+        first = replay_capture(simple_capture_path, run_oracles=False)
+        second = replay_capture(simple_capture_path, run_oracles=False)
+        assert first.identical and second.identical
+        assert first.replay_hash == second.replay_hash == first.recorded_hash
+
+    def test_checked_in_corpus_replays_byte_identically(self):
+        """The regression corpus under tests/captures/ (CI's
+        replay-corpus step runs the same entry point): every checked-in
+        capture must replay with an identical trace hash and a clean
+        oracle battery — on this machine, today, not just on the one
+        that recorded it."""
+        from agac_tpu.sim.fuzz import replay_corpus
+
+        corpus = pathlib.Path(__file__).parent / "captures"
+        assert sorted(corpus.glob("*.jsonl")), "corpus must not be empty"
+        assert replay_corpus(corpus) == 0
+
+    def test_chaos_drill_replays_identically_with_clean_oracles(
+        self, drill_capture_path
+    ):
+        """The acceptance bar: a GA-brownout + leader-kill drill
+        captured live replays through the ReplayHarness with an
+        identical event-trace hash AND passes the standard oracle
+        battery over the replayed world."""
+        capture = load_capture(drill_capture_path)
+        assert capture.verify() is None
+        kinds = {event["kind"] for event in capture.events}
+        assert {"clock", "control", "cluster", "lease", "aws"} <= kinds
+        result = replay_capture(drill_capture_path)
+        assert result.divergence is None, result.divergence and result.divergence.describe()
+        assert result.identical
+        assert result.violations == [], result.violations
+        assert result.notes == [], result.notes
+
+
+# ---------------------------------------------------------------------------
+# divergence bisection
+# ---------------------------------------------------------------------------
+
+
+def _mutate_one_outcome(src: str, dst: pathlib.Path) -> int:
+    """Corrupt exactly one recorded AWS SUCCESS outcome and re-chain
+    the tape from that point (so the file stays internally consistent
+    — ``verify()`` holds) — the seeded canary a faithful replay must
+    expose.  Returns the mutated event's serial."""
+    records = [
+        json.loads(line)
+        for line in pathlib.Path(src).read_text().splitlines()
+        if line.strip()
+    ]
+    header = records[0]
+    mode = header["clockMode"]
+    target_serial = next(
+        record["serial"]
+        for record in records[1:]
+        if record.get("kind") == "aws"
+        and record["data"].get("error") is None
+    )
+    chain = header["chain"]
+    for record in records[1:]:
+        if record.get("record") != "event":
+            continue
+        if record["serial"] == target_serial:
+            record["data"]["outcome"] = "mutated-by-canary"
+        chain = capture_mod.advance_hash(
+            chain, capture_mod.canonical_form(record, mode)
+        )
+        record["hash"] = chain
+    dst.write_text(
+        "".join(json.dumps(record, sort_keys=True) + "\n" for record in records)
+    )
+    return target_serial
+
+
+class TestBisection:
+    def test_seeded_mutation_names_exactly_that_event(
+        self, simple_capture_path, tmp_path
+    ):
+        mutated_path = tmp_path / "mutated.jsonl"
+        serial = _mutate_one_outcome(simple_capture_path, mutated_path)
+        mutated = load_capture(str(mutated_path))
+        # the tape is internally consistent — only a replay can tell
+        assert mutated.verify() is None
+        result = replay_capture(str(mutated_path), run_oracles=False)
+        assert not result.identical
+        assert result.divergence is not None
+        assert result.divergence.reason == "hash-split"
+        assert result.divergence.serial == serial, (
+            f"bisector named serial {result.divergence.serial}, "
+            f"the canary mutated {serial}"
+        )
+        assert "first divergent event" in result.divergence.describe()
+
+    def test_truncated_recording_bisects_as_early_end(self, simple_capture_path):
+        capture = load_capture(simple_capture_path)
+        shadow = [dict(event) for event in capture.events[:-2]]
+        divergence = bisect_divergence(capture, shadow)
+        assert divergence is not None
+        assert divergence.reason == "replay-ended-early"
+        assert divergence.serial == capture.events[-2]["serial"]
+
+    def test_identical_streams_bisect_to_none(self, simple_capture_path):
+        capture = load_capture(simple_capture_path)
+        assert bisect_divergence(capture, [dict(e) for e in capture.events]) is None
+
+
+# ---------------------------------------------------------------------------
+# the time machine: explain as-of
+# ---------------------------------------------------------------------------
+
+
+class TestExplainAsOf:
+    def test_mid_brownout_verdict_is_circuit_open(self, drill_capture_path):
+        """``explain --at`` mid-outage: the replayed world at t=120
+        has the GA circuit open and the service key blocked on it —
+        the verdict an operator would have seen live."""
+        capture = load_capture(drill_capture_path)
+        with ReplayHarness(capture) as harness:
+            harness.run_to(120.0)
+            answer = harness.explain("default/web")
+            assert answer["verdict"] == "circuit-open"
+            assert answer["owner"]
+
+    def test_end_of_capture_verdict_is_converged(self, drill_capture_path):
+        answer = explain_at(drill_capture_path, float("inf"), "default/web")
+        assert answer["verdict"] == "converged"
+
+
+# ---------------------------------------------------------------------------
+# the capture cursor in the post-mortem surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestCaptureCursorSurfaces:
+    def test_flightrecorder_endpoint_carries_the_cursor(self, tmp_path):
+        recorder = FlightRecorder(capacity=8)
+        recorder.record("reconcile", key="ns/x", result="success")
+        tap = IncidentCapture(
+            str(tmp_path / "live.jsonl"), clock_mode="virtual", source="test"
+        )
+        tap.record_control("poke", origin="external")
+        previous = capture_mod.install(tap)
+        server = make_health_server(0, flight_recorder=recorder)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            with urllib.request.urlopen(
+                base + "/debug/flightrecorder", timeout=5
+            ) as response:
+                dump = json.loads(response.read())
+            assert dump["capture_cursor"]["file"] == str(tmp_path / "live.jsonl")
+            assert dump["capture_cursor"]["serial"] == 1
+            assert dump["capture_cursor"]["offset"] > 0
+        finally:
+            server.shutdown()
+            server.server_close()
+            capture_mod.install(previous)
+            tap.close()
+
+    def test_sigterm_post_mortem_logs_the_cursor(self, tmp_path, caplog):
+        recorder = FlightRecorder(capacity=8)
+        recorder.record("reconcile", key="ns/x", result="success")
+        tap = IncidentCapture(
+            str(tmp_path / "live.jsonl"), clock_mode="virtual", source="test"
+        )
+        previous = capture_mod.install(tap)
+        try:
+            with caplog.at_level("INFO", logger="agac"):
+                recorder.log_dump()
+        finally:
+            capture_mod.install(previous)
+            tap.close()
+        cursor_lines = [
+            record.getMessage()
+            for record in caplog.records
+            if "capture-cursor" in record.getMessage()
+        ]
+        assert cursor_lines, "post-mortem must name the replayable artifact"
+        cursor = json.loads(cursor_lines[0].split("capture-cursor ", 1)[1])
+        assert cursor["file"] == str(tmp_path / "live.jsonl")

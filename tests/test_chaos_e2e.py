@@ -110,6 +110,14 @@ def chain_complete(aws, owner: str, lb_hostname: str) -> bool:
 
 
 @pytest.fixture(autouse=True)
+def _capture_on_failure(incident_capture_on_failure):
+    """Every chaos drill records its external-input stream (ISSUE 19);
+    a red drill keeps the replayable incident-capture-*.jsonl artifact
+    instead of leaving only a stack trace."""
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _racecheck_watchdog():
     """Chaos runs under the runtime lock-order/race detector too: fault
     injection exercises the retry/requeue interleavings where a lock-
@@ -750,9 +758,14 @@ class TestChaosFleet:
                 names_now = {
                     (r.name, r.type) for r in aws.records_in_zone(zone.id)
                 }
+                # A and the paired owner-TXT: they are deleted in
+                # separate batcher flushes, so waiting on A alone
+                # leaves a window where the TXT delete is still in
+                # flight when the asserts below read
                 return all(
-                    (f"app{i}.example.com.", "A") not in names_now
+                    (f"app{i}.example.com.", rtype) not in names_now
                     for i in range(n_r53)
+                    for rtype in ("A", "TXT")
                 )
 
             assert wait_until(swept, timeout=30.0)

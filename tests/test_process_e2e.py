@@ -26,6 +26,8 @@ import sys
 import time
 import urllib.request
 
+import pytest
+
 
 import yaml
 
@@ -61,6 +63,14 @@ FAST_LEASE_ENV = {
     "AGAC_POLL_INTERVAL": "0.02",
     "AGAC_POLL_TIMEOUT": "5",
 }
+
+
+@pytest.fixture(autouse=True)
+def _capture_on_failure(incident_capture_on_failure):
+    """Every kill-recovery drill arms the incident capture (ISSUE 19):
+    controller subprocesses inherit AGAC_CAPTURE_PATH and each records
+    its own external-input segment; a red drill keeps the artifacts."""
+    yield
 
 
 def wait_until(pred, timeout=20.0, interval=0.1):
